@@ -1,0 +1,1 @@
+from . import config, exceptions, hvd_logging, state  # noqa: F401

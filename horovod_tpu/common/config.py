@@ -1,0 +1,104 @@
+"""Environment-variable configuration surface.
+
+The reference parses all runtime knobs from HOROVOD_* environment variables at
+background-thread startup (horovod/common/operations.cc:986-1080, helpers
+set_bool_from_env/set_int_from_env at operations.cc:788-801). We keep the same
+names (both HOROVOD_* and an HVD_* alias) and the same defaults:
+
+  fusion threshold 64 MB  (operations.cc:1005)
+  cycle time 5 ms         (operations.cc:1013)
+  cache capacity 1024     (global_state.h:135)
+  stall warning 60 s      (global_state.h:67-76)
+"""
+
+import dataclasses
+import os
+
+
+def _env(name, default=None):
+    """Look up HOROVOD_<name> with HVD_<name> as an alias."""
+    for prefix in ("HOROVOD_", "HVD_"):
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def env_bool(name, default=False):
+    val = _env(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_int(name, default):
+    val = _env(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def env_float(name, default):
+    val = _env(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+def env_str(name, default=None):
+    return _env(name, default)
+
+
+@dataclasses.dataclass
+class HorovodConfig:
+    """Runtime knobs, parsed once at init (reference operations.cc:986-1080)."""
+
+    # Tensor fusion: bytes of gradient data batched into one collective.
+    fusion_threshold: int = 64 * 1024 * 1024
+    # Eager coordination cycle time in ms (pacing of the flush loop).
+    cycle_time_ms: float = 5.0
+    # Response/plan cache capacity (entries).
+    cache_capacity: int = 1024
+    # Timeline tracing output path (rank-0 only), empty disables.
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+    # Stall detection.
+    stall_check_disable: bool = False
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0  # 0 = never hard-shutdown
+    # Autotuning of fusion_threshold / cycle_time.
+    autotune: bool = False
+    autotune_log: str = ""
+    # Hierarchical (two-level ICI/DCN) collectives.
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    # Logging.
+    log_level: str = "WARNING"
+    log_timestamp: bool = False
+
+    @classmethod
+    def from_env(cls):
+        return cls(
+            fusion_threshold=env_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
+            cycle_time_ms=env_float("CYCLE_TIME", 5.0),
+            cache_capacity=env_int("CACHE_CAPACITY", 1024),
+            timeline_filename=env_str("TIMELINE", "") or "",
+            timeline_mark_cycles=env_bool("TIMELINE_MARK_CYCLES", False),
+            stall_check_disable=env_bool("STALL_CHECK_DISABLE", False),
+            stall_warning_time_seconds=env_float(
+                "STALL_CHECK_TIME_SECONDS", 60.0),
+            stall_shutdown_time_seconds=env_float(
+                "STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            autotune=env_bool("AUTOTUNE", False),
+            autotune_log=env_str("AUTOTUNE_LOG", "") or "",
+            hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE", False),
+            hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER", False),
+            log_level=env_str("LOG_LEVEL", "WARNING") or "WARNING",
+            log_timestamp=env_bool("LOG_TIMESTAMP", False),
+        )

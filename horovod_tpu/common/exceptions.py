@@ -1,0 +1,65 @@
+"""Error types for horovod_tpu.
+
+Mirrors the error surface of the reference core (Status codes in
+horovod/common/common.h:69-99 and the canned errors in
+horovod/common/operations.cc:114-124) as Python exceptions, since on TPU the
+enqueue path is Python/ctypes rather than a C++ background thread.
+"""
+
+
+class HorovodError(Exception):
+    """Base class for all horovod_tpu errors."""
+
+
+class NotInitializedError(HorovodError):
+    """Raised when the API is used before ``hvd.init()``.
+
+    Parity: basics.py returns -1 from the C core and raises ValueError
+    (reference horovod/common/basics.py:66-71).
+    """
+
+    def __init__(self, what="Horovod"):
+        super().__init__(
+            f"{what} has not been initialized; use hvd.init().")
+
+
+class ShutdownError(HorovodError):
+    """Collective was submitted after shutdown.
+
+    Parity: SHUT_DOWN_ERROR (reference horovod/common/operations.cc:114-118).
+    """
+
+    def __init__(self):
+        super().__init__(
+            "Horovod has been shut down. This was caused by an exception on "
+            "one of the ranks or an attempt to submit a collective after "
+            "shutdown() was called.")
+
+
+class DuplicateNameError(HorovodError):
+    """Two outstanding collectives share a name.
+
+    Parity: DUPLICATE_NAME_ERROR (reference horovod/common/operations.cc:121-124).
+    """
+
+    def __init__(self, name):
+        super().__init__(
+            f"Requested to collect a tensor with the same name as another "
+            f"tensor that is currently being processed: {name}. If you want "
+            f"to request another tensor, pass a different tensor name.")
+
+
+class MismatchError(HorovodError):
+    """Shape/type/op mismatch between ranks for the same tensor name.
+
+    Parity: the coordinator-side error checking in ConstructResponse
+    (reference horovod/common/operations.cc:209-371): mismatched ops,
+    dtypes, shapes, or root ranks produce an error Response for that tensor.
+    """
+
+
+class StalledError(HorovodError):
+    """A collective stalled past the shutdown deadline.
+
+    Parity: stall shutdown (reference horovod/common/operations.cc:688-769).
+    """

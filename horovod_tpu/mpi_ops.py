@@ -1,0 +1,234 @@
+"""Public collective API: init/rank/size + allreduce/allgather/broadcast.
+
+The single entry point replacing the reference's per-framework op bindings
+(horovod/torch/mpi_ops.py, horovod/tensorflow/mpi_ops.py,
+horovod/mxnet/mpi_ops.py, horovod/common/basics.py). Each op transparently
+dispatches:
+
+  * inside shard_map/pmap-traced code → XLA collectives over the mesh
+    (ops/collective_ops.py) — the compiled hot path;
+  * outside → the eager coordination core (ops/eager.py) with handles,
+    fusion, plan cache, stall detection.
+
+Handle-based async API parity: allreduce_async/poll/synchronize follow
+horovod/torch/mpi_ops.py:69-83,406-438. In-place variants (allreduce_ etc.)
+exist for signature parity but return the new value — jax.Arrays are
+immutable, so "in-place" cannot mutate the argument; callers rebind.
+"""
+
+import atexit
+import itertools
+
+import jax
+
+from .common import state as state_mod
+from .common.exceptions import NotInitializedError
+from .ops import collective_ops as cops
+from .ops import eager as eager_mod
+from .ops.compression import Compression
+
+_name_counter = itertools.count()
+
+# re-exported identity API (reference common/basics.py)
+size = state_mod.size
+local_size = state_mod.local_size
+rank = state_mod.rank
+local_rank = state_mod.local_rank
+process_rank = state_mod.process_rank
+process_count = state_mod.process_count
+is_initialized = state_mod.is_initialized
+mesh = state_mod.mesh
+
+
+def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
+         coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize horovod_tpu (reference hvd.init(), common/basics.py:29-56;
+    InitializeHorovodOnce, operations.cc:1566-1586).
+
+    Args:
+      devices: devices to form the worker mesh over (default: all).
+      mesh: a pre-built jax.sharding.Mesh to adopt (multi-axis allowed; the
+        first axis is the worker/data-parallel axis).
+      axis_name: name for the default 1-D mesh axis.
+      config: HorovodConfig override (default: parsed from HOROVOD_* env).
+      coordinator_address/num_processes/process_id: multi-host bootstrap,
+        forwarded to jax.distributed.initialize — the analogue of mpirun's
+        rendezvous (reference run/run.py:458-481). On TPU pods all three are
+        auto-detected and may be left None.
+    """
+    if state_mod.is_initialized():
+        return
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    state = state_mod.init_state(devices=devices, mesh=mesh,
+                                 axis_name=axis_name, config=config)
+    state.coordinator = eager_mod.EagerCoordinator(state)
+    atexit.register(shutdown)
+    return
+
+
+def shutdown():
+    """Shut down (reference horovod_shutdown, operations.cc:1101-1122)."""
+    state = state_mod.global_state()
+    if state.coordinator is not None:
+        state.coordinator.shutdown()
+    state_mod.shutdown_state()
+
+
+def mpi_threads_supported():
+    """Parity shim (reference operations.cc:1643-1650). There is no MPI; the
+    coordination service is always thread-safe."""
+    if not state_mod.is_initialized():
+        raise NotInitializedError()
+    return True
+
+
+def _coordinator():
+    if not state_mod.is_initialized():
+        raise NotInitializedError()
+    return state_mod.global_state().coordinator
+
+
+def _auto_name(op, name):
+    return name if name is not None else f"{op}.noname.{next(_name_counter)}"
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average=True, name=None, compression=Compression.none,
+              op=None, axis_name=None):
+    """Allreduce a tensor across workers (reference
+    horovod/tensorflow/__init__.py:36-83, horovod/torch/mpi_ops.py:85-108).
+
+    In traced code this is a ``lax.psum`` over the mesh axis; eagerly it is
+    queued, fused, and executed by the coordination core.
+    """
+    if cops.in_traced_context(axis_name):
+        return cops.allreduce_traced(tensor, average=average,
+                                     axis_name=axis_name, op=op,
+                                     compression=compression)
+    handle = allreduce_async(tensor, average=average, name=name,
+                             compression=compression)
+    return synchronize(handle)
+
+
+def allreduce_async(tensor, average=True, name=None,
+                    compression=Compression.none):
+    """Queue an allreduce; returns a handle (torch/mpi_ops.py:85-130)."""
+    coord = _coordinator()
+    compressed, ctx = compression.compress(tensor)
+    handle = coord.enqueue(_auto_name("allreduce", name), eager_mod.ALLREDUCE,
+                           compressed, average=average)
+    if ctx is not None:
+        coord.handles.get(handle).postscale = ctx  # dtype to restore
+    return handle
+
+
+# In-place spellings for API parity; jax.Arrays are immutable so these return
+# the reduced value (torch/mpi_ops.py:133-178 semantics minus mutation).
+allreduce_ = allreduce
+allreduce_async_ = allreduce_async
+
+
+def grouped_allreduce(tensors, average=True, compression=Compression.none,
+                      axis_name=None, fusion_threshold=None):
+    """Fused allreduce of many tensors at once (explicit tensor fusion)."""
+    if cops.in_traced_context(axis_name):
+        return cops.grouped_allreduce_traced(
+            tensors, average=average, axis_name=axis_name,
+            compression=compression, fusion_threshold=fusion_threshold)
+    handles = [allreduce_async(t, average=average, compression=compression)
+               for t in jax.tree_util.tree_leaves(tensors)]
+    leaves = [synchronize(h) for h in handles]
+    treedef = jax.tree_util.tree_structure(tensors)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather(tensor, name=None, axis_name=None):
+    """Concatenate each worker's tensor along dim 0 (reference
+    torch/mpi_ops.py:180-232; MPI_Allgatherv mpi_operations.cc:86-173)."""
+    if cops.in_traced_context(axis_name):
+        return cops.allgather_traced(tensor, axis_name=axis_name)
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def allgather_async(tensor, name=None):
+    coord = _coordinator()
+    return coord.enqueue(_auto_name("allgather", name), eager_mod.ALLGATHER,
+                         tensor)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast(tensor, root_rank=0, name=None, axis_name=None):
+    """Broadcast root_rank's tensor to all workers (reference
+    torch/mpi_ops.py:234-310; MPIBroadcast mpi_operations.cc:331-364)."""
+    if cops.in_traced_context(axis_name):
+        return cops.broadcast_traced(tensor, root_rank=root_rank,
+                                     axis_name=axis_name)
+    return synchronize(broadcast_async(tensor, root_rank=root_rank,
+                                       name=name))
+
+
+def broadcast_async(tensor, root_rank=0, name=None):
+    coord = _coordinator()
+    return coord.enqueue(_auto_name("broadcast", name), eager_mod.BROADCAST,
+                         tensor, root_rank=root_rank)
+
+
+broadcast_ = broadcast
+broadcast_async_ = broadcast_async
+
+
+# ---------------------------------------------------------------------------
+# reducescatter / alltoall — first-class primitives on TPU (the building
+# blocks of hierarchical allreduce and sequence parallelism; SURVEY.md §5).
+# ---------------------------------------------------------------------------
+
+def reducescatter(tensor, average=False, axis_name=None):
+    if cops.in_traced_context(axis_name):
+        return cops.reducescatter_traced(tensor, axis_name=axis_name,
+                                         average=average)
+    raise NotImplementedError(
+        "Eager reducescatter is not yet supported; call inside shard_map.")
+
+
+def alltoall(tensor, axis_name=None, split_axis=0, concat_axis=0):
+    if cops.in_traced_context(axis_name):
+        return cops.alltoall_traced(tensor, axis_name=axis_name,
+                                    split_axis=split_axis,
+                                    concat_axis=concat_axis)
+    raise NotImplementedError(
+        "Eager alltoall is not yet supported; call inside shard_map.")
+
+
+# ---------------------------------------------------------------------------
+# handle API
+# ---------------------------------------------------------------------------
+
+def poll(handle):
+    """True if the handle's collective has completed
+    (torch/mpi_ops.py:406-420)."""
+    return _coordinator().poll(handle)
+
+
+def synchronize(handle):
+    """Block until the handle completes; return the output
+    (torch/mpi_ops.py:422-438)."""
+    coord = _coordinator()
+    entry = coord.handles.get(handle)
+    restore_dtype = getattr(entry, "postscale", None)
+    result = coord.synchronize(handle)
+    if restore_dtype is not None and result is not None:
+        result = result.astype(restore_dtype)
+    return result

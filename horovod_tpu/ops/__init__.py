@@ -1,0 +1,1 @@
+from . import collective_ops, compression, eager, fusion  # noqa: F401

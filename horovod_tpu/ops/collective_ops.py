@@ -1,0 +1,169 @@
+"""Device-level collective operations.
+
+TPU-native replacement for the reference's op layer
+(horovod/common/ops/mpi_operations.cc, nccl_operations.cc): collectives are
+XLA collectives over the device mesh (ICI), not negotiated MPI/NCCL calls.
+
+Two execution contexts, one API:
+
+  * **Traced (jit) path** — called inside ``shard_map``/``pmap``-traced code
+    with the hvd mesh axis bound, these emit ``lax.psum`` /
+    ``lax.all_gather`` / etc. directly; XLA lowers them to ICI collectives.
+    This is the hot path used by DistributedOptimizer.
+  * **Eager path** — called outside a traced context, they delegate to the
+    eager coordination core (ops/eager.py), which queues, fuses and executes
+    them on the mesh — the analogue of the reference's background thread.
+
+Reference op → TPU mapping (SURVEY.md §2.2):
+  MPIAllreduce / NCCLAllreduce (mpi_operations.cc:22-84,
+    nccl_operations.cc:53-160)       → lax.psum over the mesh axis
+  MPIAllgather (mpi_operations.cc:86-173) → lax.all_gather(tiled=True)
+  MPIBroadcast (mpi_operations.cc:331-364) → masked psum from root
+  NCCLHierarchicalAllreduce (nccl_operations.cc:162-379)
+                                      → two-level ICI/DCN path (parallel/hierarchical.py)
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import state as state_mod
+from .compression import Compression
+
+# Reduction op names, parity with horovod's average flag plus explicit ops.
+SUM = "sum"
+AVERAGE = "average"
+MIN = "min"
+MAX = "max"
+
+
+def _bound_axis_names():
+    """Names of mesh axes currently bound by shard_map/pmap tracing."""
+    try:
+        from jax._src.core import get_axis_env
+        env = get_axis_env()
+        return [n for n in env.axis_sizes if isinstance(n, str)]
+    except Exception:
+        return []
+
+
+def resolve_axis(axis_name=None):
+    """Pick the collective axis: explicit > traced mesh axis > None (eager)."""
+    bound = _bound_axis_names()
+    if axis_name is not None:
+        return axis_name if axis_name in bound else None
+    if not bound:
+        return None
+    if state_mod.is_initialized():
+        for n in state_mod.global_state().mesh.axis_names:
+            if n in bound:
+                return n
+    return bound[0]
+
+
+def in_traced_context(axis_name=None):
+    return resolve_axis(axis_name) is not None
+
+
+# ---------------------------------------------------------------------------
+# Traced (in-jit) collectives — the SPMD hot path.
+# ---------------------------------------------------------------------------
+
+def allreduce_traced(tensor, average=True, axis_name=None, op=None,
+                     compression=Compression.none):
+    """Allreduce inside shard_map/pmap-traced code.
+
+    Parity: allreduce with compression (reference
+    horovod/tensorflow/__init__.py:36-83: compress → sum → decompress →
+    divide by size when averaging).
+    """
+    axis = resolve_axis(axis_name)
+    assert axis is not None, "allreduce_traced requires a bound mesh axis"
+    op = op or (AVERAGE if average else SUM)
+    compressed, ctx = compression.compress(tensor)
+    if op in (SUM, AVERAGE):
+        reduced = lax.psum(compressed, axis)
+    elif op == MIN:
+        reduced = lax.pmin(compressed, axis)
+    elif op == MAX:
+        reduced = lax.pmax(compressed, axis)
+    else:
+        raise ValueError(f"Unknown reduction op: {op}")
+    reduced = compression.decompress(reduced, ctx)
+    if op == AVERAGE:
+        reduced = reduced / lax.axis_size(axis)
+    return reduced
+
+
+def grouped_allreduce_traced(tensors, average=True, axis_name=None,
+                             compression=Compression.none,
+                             fusion_threshold=None):
+    """Fused allreduce of a list/pytree of tensors: one psum per fusion
+    bucket (reference FuseResponses, operations.cc:450-573)."""
+    from . import fusion as fusion_mod
+    axis = resolve_axis(axis_name)
+    assert axis is not None
+    if fusion_threshold is None:
+        fusion_threshold = state_mod.global_state().config.fusion_threshold \
+            if state_mod.is_initialized() else 64 * 1024 * 1024
+    leaves, treedef = jax.tree_util.tree_flatten(tensors)
+    compressed = []
+    ctxs = []
+    for leaf in leaves:
+        c, ctx = compression.compress(leaf)
+        compressed.append(c)
+        ctxs.append(ctx)
+    summed = fusion_mod.fused_map(
+        lambda flat: lax.psum(flat, axis), compressed, fusion_threshold)
+    out = []
+    for s, ctx in zip(summed, ctxs):
+        s = compression.decompress(s, ctx)
+        if average:
+            s = s / lax.axis_size(axis)
+        out.append(s)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def allgather_traced(tensor, axis_name=None):
+    """Concatenate each worker's tensor along dim 0 (reference MPIAllgather,
+    mpi_operations.cc:86-173; output allocation collective_operations.cc:68)."""
+    axis = resolve_axis(axis_name)
+    assert axis is not None
+    return lax.all_gather(tensor, axis, tiled=True)
+
+
+def broadcast_traced(tensor, root_rank=0, axis_name=None):
+    """Every worker gets root_rank's value (reference MPIBroadcast,
+    mpi_operations.cc:331-364). Implemented as a masked psum, which XLA
+    lowers to an efficient one-to-all over ICI."""
+    axis = resolve_axis(axis_name)
+    assert axis is not None
+    axis_size = lax.axis_size(axis)
+    if isinstance(root_rank, int) and not 0 <= root_rank < axis_size:
+        raise ValueError(
+            f"Invalid root_rank {root_rank}: must be in [0, {axis_size}).")
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root_rank, tensor,
+                       jnp.zeros_like(tensor))
+    return lax.psum(masked, axis)
+
+
+def reducescatter_traced(tensor, axis_name=None, average=False):
+    """Reduce-scatter: each worker gets one summed shard (the building block
+    of the reference's hierarchical path, nccl_operations.cc:269)."""
+    axis = resolve_axis(axis_name)
+    assert axis is not None
+    out = lax.psum_scatter(tensor, axis, tiled=True)
+    if average:
+        out = out / lax.axis_size(axis)
+    return out
+
+
+def alltoall_traced(tensor, axis_name=None, split_axis=0, concat_axis=0):
+    """All-to-all over the mesh axis (first-class primitive for sequence
+    parallelism; the reference exposes no alltoall — extension noted in
+    SURVEY.md §5)."""
+    axis = resolve_axis(axis_name)
+    assert axis is not None
+    return lax.all_to_all(tensor, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)

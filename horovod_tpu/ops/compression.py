@@ -1,0 +1,79 @@
+"""Gradient compression algorithms.
+
+Parity with the reference's Compressor interface (horovod/torch/compression.py
+and horovod/tensorflow/compression.py:20-74): ``compress`` returns
+(compressed_tensor, ctx), ``decompress`` restores the original dtype. The
+reference ships NoneCompressor and FP16Compressor; on TPU bfloat16 is the
+native 16-bit wire/compute format (MXU-friendly), so we add a BF16Compressor
+and make it the recommended choice.
+
+These are pure jax functions: they trace cleanly under jit and the casts fuse
+into the surrounding collective.
+"""
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface to compress and decompress a tensor
+    (reference compression.py:20-33)."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context_for_decompression)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """No-op (reference compression.py:36-47)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast float tensors to fp16 on the wire
+    (reference compression.py:50-65)."""
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast float tensors to bfloat16 on the wire. TPU-native: bf16 is
+    supported end-to-end by the MXU and ICI, unlike fp16 which the reference
+    needed a software MPI sum for (horovod/common/half.cc:42-75)."""
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference compression.py:68-74)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,526 @@
+"""Eager coordination core: queue → fuse → execute → callback.
+
+TPU-native replacement for the reference's background thread + rank-0
+negotiation (BackgroundThreadLoop operations.cc:857, RunLoopOnce
+operations.cc:1246, protocol comment operations.cc:1217-1245).
+
+Why it is different on TPU: the reference's per-step wire negotiation exists
+because eager GPU frameworks submit tensors in nondeterministic order across
+ranks (operations.cc:852-855). Single-controller JAX has no such problem —
+every process runs the same Python program, so submission order is already
+identical everywhere. What survives is the *local* machinery, which this
+module provides with full parity:
+
+  * tensor table keyed by name, duplicate-name detection
+    (DUPLICATE_NAME_ERROR, operations.cc:121; EnqueueTensorAllreduce
+    operations.cc:1654)
+  * a paced background flush loop (HOROVOD_CYCLE_TIME, default 5 ms,
+    operations.cc:1013)
+  * tensor fusion into bucketed collectives (HOROVOD_FUSION_THRESHOLD,
+    FuseResponses operations.cc:450-573)
+  * an LRU plan cache, the analogue of the response cache + bypass fast path
+    (response_cache.h:43-92, RunBypass operations.cc:1168-1215)
+  * integer handles with poll/synchronize semantics
+    (torch/handle_manager.h:30-41, torch/mpi_ops.py:406-438)
+  * stall detection with warning/shutdown deadlines
+    (CheckForStalledTensors operations.cc:688-769)
+  * timeline spans (NEGOTIATE_*, MEMCPY_IN_FUSION_BUFFER, ALLREDUCE, ...)
+
+Eager input conventions (single-controller SPMD):
+
+  * An array whose leading dim equals ``size()`` is **stacked**: row i is
+    worker i's tensor (the pmap convention). Collectives run on-device over
+    the mesh; the result keeps the stacked shape.
+  * A list of arrays is per-local-worker input with possibly different
+    first dims — the allgatherv case (MPI_Allgatherv,
+    mpi_operations.cc:86-173).
+  * Any other array is **replicated**: this process's single contribution.
+    Participants are the host processes; with one process an allreduce is
+    the identity, exactly like a 1-rank Horovod run.
+"""
+
+import collections
+import functools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common import hvd_logging as log
+from ..common import state as state_mod
+from ..common.exceptions import (DuplicateNameError, MismatchError,
+                                 ShutdownError, StalledError)
+from ..utils import timeline as timeline_mod
+
+ALLREDUCE = "allreduce"
+ALLGATHER = "allgather"
+BROADCAST = "broadcast"
+ALLTOALL = "alltoall"
+
+
+class TensorTableEntry:
+    """Parity: TensorTableEntry (common.h:167-184)."""
+
+    __slots__ = ("name", "op", "tensor", "root_rank", "average", "kind",
+                 "handle", "result", "status", "event", "enqueue_time",
+                 "prescale", "postscale")
+
+    def __init__(self, name, op, tensor, root_rank=0, average=False,
+                 kind="replicated", handle=None):
+        self.name = name
+        self.op = op
+        self.tensor = tensor
+        self.root_rank = root_rank
+        self.average = average
+        self.kind = kind
+        self.handle = handle
+        self.result = None
+        self.status = None  # None = pending, True = ok, Exception = error
+        self.event = threading.Event()
+        self.enqueue_time = time.monotonic()
+
+    def signature(self):
+        if self.kind == "list":
+            shapes = tuple(tuple(t.shape) for t in self.tensor)
+            dtypes = tuple(str(t.dtype) for t in self.tensor)
+        else:
+            shapes = tuple(self.tensor.shape)
+            dtypes = str(self.tensor.dtype)
+        return (self.op, self.name, shapes, dtypes, self.root_rank,
+                self.average, self.kind)
+
+
+class HandleManager:
+    """Integer async handles (torch/handle_manager.h:30-41)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._entries = {}
+
+    def allocate(self, entry):
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._entries[h] = entry
+            entry.handle = h
+            return h
+
+    def get(self, handle):
+        with self._lock:
+            entry = self._entries.get(handle)
+        if entry is None:
+            raise ValueError(f"Handle {handle} was not created or has "
+                             f"already been released.")
+        return entry
+
+    def poll(self, handle):
+        return self.get(handle).event.is_set()
+
+    def release(self, handle):
+        with self._lock:
+            self._entries.pop(handle, None)
+
+
+class PlanCache:
+    """LRU plan cache — response-cache analogue (response_cache.h:43-92).
+
+    Maps the signature of a drained batch to its fusion plan so repeat
+    iterations skip planning entirely (the RunBypass fast path,
+    operations.cc:1168-1215). Hit/miss counters feed tests and the
+    autotuner.
+    """
+
+    def __init__(self, capacity):
+        self.capacity = capacity
+        self._cache = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        plan = self._cache.get(key)
+        if plan is not None:
+            self._cache.move_to_end(key)
+            self.hits += 1
+        else:
+            self.misses += 1
+        return plan
+
+    def put(self, key, plan):
+        if self.capacity <= 0:
+            return
+        self._cache[key] = plan
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.capacity:
+            self._cache.popitem(last=False)
+
+    def clear(self):
+        self._cache.clear()
+
+
+class EagerCoordinator:
+    """The per-process coordination core (BackgroundThreadLoop analogue)."""
+
+    def __init__(self, state):
+        self._state = state
+        self._config = state.config
+        self._mesh = state.mesh
+        self._axis = state.mesh.axis_names[0]
+        self._world = int(state.mesh.devices.size)
+        self._queue = collections.deque()
+        self._queue_lock = threading.Lock()
+        self._tensor_table = {}  # outstanding names → entry
+        self._flush_lock = threading.Lock()
+        self.handles = HandleManager()
+        self.plan_cache = PlanCache(self._config.cache_capacity)
+        self._shutdown = False
+        self._paused = False  # test hook: lets stall detection be exercised
+        self._stall_warned = set()
+        self.timeline = timeline_mod.create_from_env(
+            self._config, jax.process_index() == 0)
+        self._thread = threading.Thread(
+            target=self._background_loop, daemon=True, name="hvd-background")
+        self._thread.start()
+
+    # -- enqueue API (EnqueueTensorAllreduce/..., operations.cc:1654-1770) --
+
+    def enqueue(self, name, op, tensor, root_rank=0, average=False):
+        if self._shutdown:
+            raise ShutdownError()
+        if op == BROADCAST and not 0 <= root_rank < self._world:
+            raise MismatchError(
+                f"Invalid root_rank {root_rank} for broadcast '{name}': "
+                f"must be in [0, {self._world}).")
+        entry_kind = self._classify(tensor)
+        with self._queue_lock:
+            if name in self._tensor_table:
+                raise DuplicateNameError(name)
+            entry = TensorTableEntry(name, op, tensor, root_rank=root_rank,
+                                     average=average, kind=entry_kind)
+            self._tensor_table[name] = entry
+            self._queue.append(entry)
+        handle = self.handles.allocate(entry)
+        if self.timeline:
+            self.timeline.negotiate_start(name, op)
+        return handle
+
+    def _classify(self, tensor):
+        if isinstance(tensor, (list, tuple)):
+            return "list"
+        if (hasattr(tensor, "ndim") and tensor.ndim >= 1 and
+                tensor.shape[0] == self._world):
+            return "stacked"
+        return "replicated"
+
+    # -- handle API --
+
+    def poll(self, handle):
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle):
+        """Block until the handle's collective completes and return its
+        output (torch/mpi_ops.py:422-438)."""
+        entry = self.handles.get(handle)
+        deadline = None
+        if self._config.stall_shutdown_time_seconds > 0:
+            deadline = (entry.enqueue_time +
+                        self._config.stall_shutdown_time_seconds)
+        while not entry.event.is_set():
+            if not self._paused:
+                self.flush()
+            if entry.event.wait(timeout=self._config.cycle_time_ms / 1000.0):
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise StalledError(
+                    f"Collective '{entry.name}' stalled for more than "
+                    f"{self._config.stall_shutdown_time_seconds}s.")
+        self.handles.release(handle)
+        if isinstance(entry.status, Exception):
+            raise entry.status
+        return entry.result
+
+    # -- the cycle loop (RunLoopOnce, operations.cc:1246) --
+
+    def _background_loop(self):
+        while not self._shutdown:
+            time.sleep(self._config.cycle_time_ms / 1000.0)
+            if self._paused:
+                continue
+            try:
+                self.flush()
+            except Exception as exc:  # never kill the loop
+                log.error("background flush failed: %s", exc)
+            self._check_stalled()
+
+    def flush(self):
+        """Drain the queue and execute everything in it (one cycle)."""
+        with self._flush_lock:
+            with self._queue_lock:
+                batch = list(self._queue)
+                self._queue.clear()
+            if not batch:
+                return
+            if self.timeline:
+                self.timeline.mark_cycle_start()
+                for e in batch:
+                    self.timeline.negotiate_end(e.name)
+            key = tuple(e.signature() for e in batch)
+            plan = self.plan_cache.get(key)
+            if plan is None:
+                plan = self._make_plan(batch)
+                self.plan_cache.put(key, plan)
+            self._execute(batch, plan)
+
+    def _make_plan(self, batch):
+        """Group fusable entries (stacked allreduces by dtype/average), one
+        group per other entry — FuseResponses parity."""
+        from . import fusion as fusion_mod
+        groups = []
+        fusable = [i for i, e in enumerate(batch)
+                   if e.op == ALLREDUCE and e.kind == "stacked"]
+        if fusable:
+            leaves = [batch[i].tensor for i in fusable]
+            # bucket per (dtype, average) in submission order
+            by_key = collections.OrderedDict()
+            for i in fusable:
+                e = batch[i]
+                by_key.setdefault((str(e.tensor.dtype), e.average),
+                                  []).append(i)
+            for (_, average), idxs in by_key.items():
+                buckets = fusion_mod.plan_buckets(
+                    [batch[i].tensor for i in idxs],
+                    self._config.fusion_threshold)
+                for b in buckets:
+                    groups.append(("fused_allreduce",
+                                   [idxs[j] for j in b.indices], average))
+        for i, e in enumerate(batch):
+            if e.op == ALLREDUCE and e.kind == "stacked":
+                continue
+            groups.append((e.op + ":" + e.kind, [i], e.average))
+        return groups
+
+    def _execute(self, batch, plan):
+        for kind, idxs, average in plan:
+            entries = [batch[i] for i in idxs]
+            try:
+                if kind == "fused_allreduce":
+                    self._exec_fused_stacked_allreduce(entries, average)
+                else:
+                    op, entry_kind = kind.split(":")
+                    self._exec_single(entries[0], op, entry_kind)
+                for e in entries:
+                    e.status = True
+            except Exception as exc:
+                for e in entries:
+                    e.status = exc
+            finally:
+                with self._queue_lock:
+                    for e in entries:
+                        self._tensor_table.pop(e.name, None)
+                        e.event.set()
+
+    # -- execution engines --
+
+    def _sharding(self, spec):
+        return NamedSharding(self._mesh, spec)
+
+    @functools.cached_property
+    def _stacked_psum(self):
+        mesh, axis = self._mesh, self._axis
+
+        @jax.jit
+        def f(x):
+            return jax.shard_map(
+                lambda s: lax.psum(s, axis), mesh=mesh,
+                in_specs=P(axis), out_specs=P(axis))(x)
+        return f
+
+    @functools.cached_property
+    def _stacked_bcast(self):
+        mesh, axis = self._mesh, self._axis
+
+        @functools.partial(jax.jit, static_argnums=1)
+        def f(x, root):
+            def shard_fn(s):
+                idx = lax.axis_index(axis)
+                masked = jnp.where(idx == root, s, jnp.zeros_like(s))
+                return lax.psum(masked, axis)
+            return jax.shard_map(shard_fn, mesh=mesh, in_specs=P(axis),
+                                 out_specs=P(axis))(x)
+        return f
+
+    def _put_stacked(self, arr):
+        """Shard a [world, ...] array over the worker axis."""
+        spec = P(self._axis, *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, self._sharding(spec))
+
+    def _exec_fused_stacked_allreduce(self, entries, average):
+        """Fuse [world, n_i] tensors into one [world, total] buffer, one
+        psum, split back (MPIAllreduce memcpy-in/allreduce/memcpy-out,
+        mpi_operations.cc:25-66)."""
+        tl = self.timeline
+        names = [e.name for e in entries]
+        if tl:
+            for n in names:
+                tl.start_activity(n, timeline_mod.MEMCPY_IN_FUSION_BUFFER)
+        flats = [jnp.reshape(jnp.asarray(e.tensor), (self._world, -1))
+                 for e in entries]
+        fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats, axis=1)
+        fused = self._put_stacked(fused)
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+                tl.start_activity(n, timeline_mod.ALLREDUCE)
+        summed = self._stacked_psum(fused)
+        if average:
+            summed = summed / self._world
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+                tl.start_activity(n, timeline_mod.MEMCPY_OUT_FUSION_BUFFER)
+        offset = 0
+        for e, flat in zip(entries, flats):
+            n = flat.shape[1]
+            e.result = jnp.reshape(summed[:, offset:offset + n],
+                                   np.shape(e.tensor))
+            offset += n
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+        return entries
+
+    def _exec_single(self, entry, op, entry_kind):
+        tl = self.timeline
+        if tl:
+            tl.start_activity(entry.name, op.upper())
+        try:
+            if op == ALLREDUCE:
+                entry.result = self._allreduce_one(entry, entry_kind)
+            elif op == ALLGATHER:
+                entry.result = self._allgather_one(entry, entry_kind)
+            elif op == BROADCAST:
+                entry.result = self._broadcast_one(entry, entry_kind)
+            else:
+                raise ValueError(f"Unknown op {op}")
+        finally:
+            if tl:
+                tl.end_activity(entry.name)
+
+    def _allreduce_one(self, entry, kind):
+        if kind == "stacked":
+            x = self._put_stacked(
+                jnp.reshape(jnp.asarray(entry.tensor), (self._world, -1)))
+            out = self._stacked_psum(x)
+            if entry.average:
+                out = out / self._world
+            return jnp.reshape(out, np.shape(entry.tensor))
+        # replicated: participants are host processes.
+        if jax.process_count() == 1:
+            return jnp.asarray(entry.tensor)
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(entry.tensor))
+        out = jnp.sum(gathered, axis=0)
+        if entry.average:
+            out = out / jax.process_count()
+        return out
+
+    def _allgather_one(self, entry, kind):
+        if kind == "list":
+            tensors = [jnp.asarray(t) for t in entry.tensor]
+            self._check_gather_shapes(entry.name, tensors)
+            return jnp.concatenate(tensors, axis=0)
+        if kind == "stacked":
+            # [world, d0, ...] → concat along dim 0 → [world*d0, ...]
+            t = jnp.asarray(entry.tensor)
+            return jnp.reshape(t, (self._world * t.shape[1],) + t.shape[2:])
+        if jax.process_count() == 1:
+            return jnp.asarray(entry.tensor)
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(entry.tensor))
+        return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
+
+    def _broadcast_one(self, entry, kind):
+        if kind == "stacked":
+            x = self._put_stacked(jnp.asarray(entry.tensor))
+            return self._stacked_bcast(x, int(entry.root_rank))
+        if jax.process_count() == 1:
+            return jnp.asarray(entry.tensor)
+        from jax.experimental import multihost_utils
+        return multihost_utils.broadcast_one_to_all(
+            jnp.asarray(entry.tensor),
+            is_source=jax.process_index() == entry.root_rank)
+
+    def _check_gather_shapes(self, name, tensors):
+        """Allgather rank/dim checks (ConstructResponse,
+        operations.cc:290-307): ranks may differ in dim 0 only."""
+        first = tensors[0]
+        for t in tensors[1:]:
+            if t.dtype != first.dtype:
+                raise MismatchError(
+                    f"Mismatched data types for allgather '{name}': "
+                    f"{first.dtype} vs {t.dtype}.")
+            if t.ndim != first.ndim or t.shape[1:] != first.shape[1:]:
+                raise MismatchError(
+                    f"Mismatched allgather tensor shapes for '{name}': all "
+                    f"dimensions except the first must match "
+                    f"({first.shape} vs {t.shape}).")
+
+    # -- stall detection (CheckForStalledTensors, operations.cc:688-769) --
+
+    def _check_stalled(self):
+        if self._config.stall_check_disable:
+            return
+        now = time.monotonic()
+        warn = self._config.stall_warning_time_seconds
+        kill = self._config.stall_shutdown_time_seconds
+        with self._queue_lock:
+            pending = list(self._tensor_table.values())
+        stalled = [e for e in pending if now - e.enqueue_time > warn]
+        new = [e for e in stalled if e.name not in self._stall_warned]
+        if new:
+            names = ", ".join(e.name for e in new)
+            log.warning(
+                "One or more tensors were submitted to be reduced, gathered "
+                "or broadcasted by subset of ranks and are waiting for "
+                "remainder of ranks for more than %ss: %s", warn, names)
+            self._stall_warned.update(e.name for e in new)
+        if kill > 0:
+            dead = [e for e in pending if now - e.enqueue_time > kill]
+            if dead:
+                exc = StalledError(
+                    f"Collectives stalled past shutdown deadline: "
+                    f"{', '.join(e.name for e in dead)}")
+                with self._queue_lock:
+                    for e in dead:
+                        self._tensor_table.pop(e.name, None)
+                        try:
+                            self._queue.remove(e)
+                        except ValueError:
+                            pass
+                for e in dead:
+                    e.status = exc
+                    e.event.set()
+
+    # -- shutdown (horovod_shutdown, operations.cc:1101-1122) --
+
+    def shutdown(self):
+        self._shutdown = True
+        with self._queue_lock:
+            pending = list(self._tensor_table.values())
+            self._tensor_table.clear()
+            self._queue.clear()
+        exc = ShutdownError()
+        for e in pending:
+            e.status = exc
+            e.event.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
+        if self.timeline:
+            self.timeline.close()
+            self.timeline = None

@@ -1,0 +1,132 @@
+"""Horovod Timeline: Chrome-tracing JSON of collective activity.
+
+Parity with the reference timeline (horovod/common/timeline.{h,cc}):
+  * enabled by HOROVOD_TIMELINE=<file> on rank 0 (operations.cc:986-994)
+  * per-tensor lifecycle: NEGOTIATE_<OP> phase, then top-level op span, then
+    per-activity sub-spans (timeline.h:76 states NEGOTIATING/TOP_LEVEL/ACTIVITY)
+  * writes happen on a dedicated writer thread fed by a queue so the hot path
+    never blocks (reference uses a boost SPSC lock-free queue,
+    timeline.h:46-74; Python's queue.SimpleQueue is the equivalent here —
+    the native C++ runtime has its own writer)
+  * optional cycle markers via HOROVOD_TIMELINE_MARK_CYCLES
+    (operations.cc:996, 1258-1261)
+
+Activity-name parity (common.h:30-51): QUEUE, MEMCPY_IN_FUSION_BUFFER,
+ALLREDUCE, MEMCPY_OUT_FUSION_BUFFER, ALLGATHER, BROADCAST, NEGOTIATE_*.
+
+Events use the Chrome trace "ph" codes the reference emits: "M" metadata,
+"B"/"E" begin/end, "i" instant (timeline.cc WriteEvent).
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+
+# Activity names (reference common.h:30-51).
+QUEUE = "QUEUE"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+ALLREDUCE = "ALLREDUCE"
+ALLGATHER = "ALLGATHER"
+BROADCAST = "BROADCAST"
+NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
+NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
+NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"
+CYCLE_START = "CYCLE_START"
+
+
+class Timeline:
+    """Chrome-trace writer with a background writer thread."""
+
+    def __init__(self, filename, mark_cycles=False):
+        self._filename = filename
+        self._mark_cycles = mark_cycles
+        self._queue = queue.SimpleQueue()
+        self._tensor_pids = {}
+        self._next_pid = 1
+        self._lock = threading.Lock()
+        self._healthy = True
+        self._start = time.monotonic()
+        self._file = open(filename, "w")
+        self._file.write("[\n")
+        self._thread = threading.Thread(target=self._writer_loop, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._thread.start()
+
+    @property
+    def enabled(self):
+        return self._healthy
+
+    def _ts_us(self):
+        return int((time.monotonic() - self._start) * 1e6)
+
+    def _pid_for(self, tensor_name):
+        with self._lock:
+            pid = self._tensor_pids.get(tensor_name)
+            if pid is None:
+                pid = self._next_pid
+                self._next_pid += 1
+                self._tensor_pids[tensor_name] = pid
+                # Metadata event naming the lane, like the reference's
+                # process_name metadata (timeline.cc).
+                self._emit({"name": "process_name", "ph": "M", "pid": pid,
+                            "args": {"name": tensor_name}})
+                self._emit({"name": "process_sort_index", "ph": "M",
+                            "pid": pid, "args": {"sort_index": pid}})
+            return pid
+
+    def _emit(self, event):
+        self._queue.put(event)
+
+    def start_activity(self, tensor_name, activity):
+        pid = self._pid_for(tensor_name)
+        self._emit({"name": activity, "ph": "B", "pid": pid,
+                    "ts": self._ts_us()})
+
+    def end_activity(self, tensor_name, activity=None):
+        pid = self._pid_for(tensor_name)
+        self._emit({"ph": "E", "pid": pid, "ts": self._ts_us()})
+
+    def negotiate_start(self, tensor_name, op_name):
+        self.start_activity(tensor_name, f"NEGOTIATE_{op_name.upper()}")
+
+    def negotiate_end(self, tensor_name):
+        self.end_activity(tensor_name)
+
+    def mark_cycle_start(self):
+        if self._mark_cycles:
+            self._emit({"name": CYCLE_START, "ph": "i", "pid": 0, "s": "g",
+                        "ts": self._ts_us()})
+
+    def _writer_loop(self):
+        while True:
+            event = self._queue.get()
+            if event is None:
+                break
+            try:
+                self._file.write(json.dumps(event) + ",\n")
+                self._file.flush()
+            except Exception:
+                self._healthy = False
+                return
+
+    def close(self):
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        try:
+            # Chrome tracing tolerates a trailing comma / missing "]", same
+            # as the reference which never closes the array; close it anyway.
+            self._file.write("{}]\n")
+            self._file.close()
+        except Exception:
+            pass
+
+
+def create_from_env(config, is_coordinator):
+    """Rank-0-only creation (reference operations.cc:986-994)."""
+    if config.timeline_filename and is_coordinator:
+        return Timeline(config.timeline_filename,
+                        mark_cycles=config.timeline_mark_cycles)
+    return None

@@ -1,0 +1,45 @@
+"""Identity/init API tests (reference test/test_torch.py rank/size checks and
+horovod/common/basics.py semantics)."""
+
+import pytest
+
+
+def test_not_initialized_errors():
+    import horovod_tpu as hvd_mod
+    if hvd_mod.is_initialized():
+        hvd_mod.shutdown()
+    with pytest.raises(hvd_mod.NotInitializedError):
+        hvd_mod.size()
+    with pytest.raises(hvd_mod.NotInitializedError):
+        hvd_mod.rank()
+
+
+def test_init_size_rank(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0          # first device of this (only) process
+    assert hvd.local_rank() == 0
+    assert hvd.process_rank() == 0
+    assert hvd.process_count() == 1
+    assert hvd.mpi_threads_supported() is True
+
+
+def test_double_init_is_noop(hvd):
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_rank_inside_shard_map(hvd):
+    """rank() inside shard_map is the per-device index (SPMD identity)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.mesh()
+
+    def f(x):
+        return x + hvd.rank()
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("hvd"),
+                                out_specs=P("hvd")))(jnp.zeros(8))
+    assert list(out) == list(range(8))

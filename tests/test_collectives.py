@@ -1,0 +1,223 @@
+"""Collective op tests — the analogue of the reference's op matrix
+(test/test_torch.py, test/test_tensorflow.py): every collective, eager and
+traced, with rank-dependent deterministic data and exact-value asserts
+(SURVEY.md §4 'tensor = rank * ones' pattern)."""
+
+import numpy as np
+import pytest
+
+
+def _traced(hvd, fn, *args, in_specs=None, out_specs=None):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = hvd.mesh()
+    in_specs = in_specs if in_specs is not None else P("hvd")
+    out_specs = out_specs if out_specs is not None else P("hvd")
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))(*args)
+
+
+# ---------------------------------------------------------------------------
+# traced (in-jit) path
+# ---------------------------------------------------------------------------
+
+class TestTraced:
+    def test_allreduce_sum(self, hvd):
+        import jax.numpy as jnp
+        x = jnp.arange(8.0).reshape(8, 1)  # worker i holds value i
+        out = _traced(hvd, lambda s: hvd.allreduce(s, average=False), x)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+    def test_allreduce_average(self, hvd):
+        import jax.numpy as jnp
+        x = jnp.arange(8.0).reshape(8, 1)
+        out = _traced(hvd, lambda s: hvd.allreduce(s, average=True), x)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.5))
+
+    def test_allreduce_fp16_compression(self, hvd):
+        import jax.numpy as jnp
+        x = jnp.ones((8, 4), jnp.float32)
+        out = _traced(
+            hvd, lambda s: hvd.allreduce(s, average=False,
+                                         compression=hvd.Compression.fp16), x)
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 4), 8.0))
+
+    def test_allreduce_min_max(self, hvd):
+        import jax.numpy as jnp
+        x = jnp.arange(8.0).reshape(8, 1)
+        out = _traced(hvd, lambda s: hvd.allreduce(s, op="min"), x)
+        np.testing.assert_allclose(np.asarray(out), np.zeros((8, 1)))
+        out = _traced(hvd, lambda s: hvd.allreduce(s, op="max"), x)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 7.0))
+
+    def test_allgather(self, hvd):
+        import jax.numpy as jnp
+        x = jnp.arange(8.0).reshape(8, 1)  # worker i holds [i]
+        out = _traced(hvd, hvd.allgather, x)
+        # each worker gets the concat of all workers' rows
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(np.arange(8.0)[:, None], (8, 1))
+                                   .reshape(64, 1)[:64])
+
+    def test_broadcast(self, hvd):
+        import jax.numpy as jnp
+        x = jnp.arange(8.0).reshape(8, 1)
+        out = _traced(hvd, lambda s: hvd.broadcast(s, root_rank=3), x)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+    def test_reducescatter(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        # every worker holds [0..7]; reduce-scatter gives worker i 8*i
+        x = jnp.tile(jnp.arange(8.0), (8, 1))
+        out = _traced(hvd, lambda s: hvd.reducescatter(s[0]), x,
+                      in_specs=P("hvd"), out_specs=P("hvd"))
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 8)
+
+    def test_alltoall(self, hvd):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        # worker i holds row of 8 values 10*i + [0..7]
+        x = jnp.arange(64.0).reshape(8, 8)
+        out = _traced(hvd, lambda s: hvd.alltoall(s, split_axis=1,
+                                                  concat_axis=0),
+                      x, in_specs=P("hvd"), out_specs=P("hvd"))
+        # worker j receives column j of every worker (shape [8,1] each);
+        # global result is the transpose, flattened to (64, 1)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(8, 8),
+            np.arange(64.0).reshape(8, 8).T)
+
+    def test_grouped_allreduce_fused(self, hvd):
+        import jax.numpy as jnp
+        xs = {"a": jnp.arange(8.0).reshape(8, 1),
+              "b": jnp.ones((8, 3), jnp.float32)}
+        out = _traced(
+            hvd,
+            lambda a, b: hvd.grouped_allreduce({"a": a, "b": b},
+                                               average=False),
+            xs["a"], xs["b"],
+            in_specs=None or __import__("jax").sharding.PartitionSpec("hvd"),
+            out_specs=__import__("jax").sharding.PartitionSpec("hvd"))
+        np.testing.assert_allclose(np.asarray(out["a"]),
+                                   np.full((8, 1), 28.0))
+        np.testing.assert_allclose(np.asarray(out["b"]),
+                                   np.full((8, 3), 8.0))
+
+
+# ---------------------------------------------------------------------------
+# eager path (coordination core)
+# ---------------------------------------------------------------------------
+
+class TestEager:
+    def test_allreduce_stacked_sum(self, hvd):
+        x = np.arange(8.0).reshape(8, 1) * np.ones((8, 3))
+        out = hvd.allreduce(x, average=False)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((8, 3), 28.0) * np.ones((8, 3)))
+
+    def test_allreduce_stacked_average(self, hvd):
+        x = np.arange(8.0).reshape(8, 1) * np.ones((8, 3))
+        out = hvd.allreduce(x, average=True)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 3), 3.5))
+
+    def test_allreduce_replicated_single_process(self, hvd):
+        # 1 process → allreduce over 1 participant = identity (like a
+        # single-rank horovod run)
+        x = np.full((3, 3), 4.0)
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x)), x)
+
+    def test_allreduce_async_poll_synchronize(self, hvd):
+        x = np.arange(8.0).reshape(8, 1)
+        h = hvd.allreduce_async(x, average=False)
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+    def test_duplicate_name_error(self, hvd):
+        import horovod_tpu
+        x = np.zeros((8, 1))
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord._paused = True  # hold the flush so both enqueues overlap
+        try:
+            hvd.allreduce_async(x, name="dup")
+            with pytest.raises(hvd.DuplicateNameError):
+                hvd.allreduce_async(x, name="dup")
+        finally:
+            coord._paused = False
+
+    def test_allgather_stacked(self, hvd):
+        x = np.arange(8.0).reshape(8, 1, 1) + np.zeros((8, 1, 2))
+        out = hvd.allgather(x)
+        assert out.shape == (8, 2)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], np.arange(8.0))
+
+    def test_allgather_variable_size(self, hvd):
+        # reference test_horovod_allgather_variable_size
+        # (test/test_tensorflow.py:563): ranks contribute different dim-0.
+        tensors = [np.full((i + 1, 2), float(i)) for i in range(8)]
+        out = hvd.allgather(tensors)
+        assert out.shape == (sum(i + 1 for i in range(8)), 2)
+        row = 0
+        for i in range(8):
+            np.testing.assert_allclose(np.asarray(out)[row:row + i + 1],
+                                       np.full((i + 1, 2), float(i)))
+            row += i + 1
+
+    def test_allgather_type_mismatch_error(self, hvd):
+        tensors = [np.zeros((2, 2), np.float32), np.zeros((2, 2), np.int32)]
+        with pytest.raises(hvd.MismatchError):
+            hvd.allgather(tensors)
+
+    def test_allgather_shape_mismatch_error(self, hvd):
+        tensors = [np.zeros((2, 2)), np.zeros((2, 3))]
+        with pytest.raises(hvd.MismatchError):
+            hvd.allgather(tensors)
+
+    def test_broadcast_stacked(self, hvd):
+        x = np.arange(8.0).reshape(8, 1) * np.ones((8, 4))
+        out = hvd.broadcast(x, root_rank=5)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 4), 5.0))
+
+    def test_broadcast_replicated_identity(self, hvd):
+        x = np.full((2, 2), 7.0)
+        np.testing.assert_allclose(np.asarray(hvd.broadcast(x, root_rank=0)),
+                                   x)
+
+    def test_eager_fusion_batches_small_tensors(self, hvd):
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord._paused = True
+        try:
+            handles = [hvd.allreduce_async(
+                np.full((8, 2), float(i)), average=False, name=f"fuse{i}")
+                for i in range(4)]
+            coord._paused = False
+            coord.flush()
+            outs = [hvd.synchronize(h) for h in handles]
+        finally:
+            coord._paused = False
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.full((8, 2), 8.0 * i))
+
+    def test_plan_cache_hit_on_repeat(self, hvd):
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord.plan_cache.clear()
+        hits0 = coord.plan_cache.hits
+        x = np.ones((8, 2))
+        for _ in range(3):
+            coord._paused = True
+            h = hvd.allreduce_async(x, average=False, name="cached")
+            coord._paused = False
+            coord.flush()
+            hvd.synchronize(h)
+        assert coord.plan_cache.hits >= hits0 + 2
+
+    def test_shutdown_error_after_shutdown(self, hvd):
+        hvd.shutdown()
+        with pytest.raises((hvd.NotInitializedError, hvd.ShutdownError)):
+            hvd.allreduce(np.zeros((8, 1)))
+        hvd.init()  # restore for fixture teardown
