@@ -1,0 +1,96 @@
+"""Headline benchmark: ResNet-50 synthetic images/sec on the local chip(s).
+
+Parity with the reference harness (examples/pytorch_synthetic_benchmark.py:
+ResNet-50, synthetic ImageNet-shaped data, 10 warmup batches, 10 iters x 10
+batches, reports img/sec). Baseline for vs_baseline is the published
+single-GPU Pascal P100 ResNet-50 fp32 throughput (~219 img/sec) underlying
+the reference's 512-GPU scaling chart (docs/benchmarks.md:6-7) — the
+per-worker number our per-chip number must beat.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BASELINE_IMG_PER_SEC_PER_WORKER = 219.0  # P100 ResNet-50, reference baseline
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import trainer
+    from horovod_tpu.models import resnet
+
+    hvd.init()
+    n_chips = hvd.size()
+    mesh = hvd.mesh()
+
+    platform = jax.devices()[0].platform
+    batch_per_chip = 128 if platform == "tpu" else 4
+    image_size = 224 if platform == "tpu" else 64
+    batch = batch_per_chip * n_chips
+
+    model = resnet.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(rng, images[:2], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt_state = tx.init(params)
+
+    def loss_fn(p, batch_data):
+        imgs, lbls = batch_data
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": batch_stats}, imgs, train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, lbls[:, None],
+                                             axis=-1))
+
+    step = trainer.make_data_parallel_step(loss_fn, tx, mesh, donate=False)
+    data_sharding = jax.sharding.NamedSharding(
+        mesh, P(mesh.axis_names[0]))
+    images = jax.device_put(images, data_sharding)
+    labels = jax.device_put(labels, data_sharding)
+
+    # warmup (reference: 10 warmup batches)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, (images, labels))
+    jax.block_until_ready(loss)
+
+    iters, inner = (10, 10) if platform == "tpu" else (3, 3)
+    rates = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            params, opt_state, loss = step(params, opt_state,
+                                           (images, labels))
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(batch * inner / dt)
+
+    img_sec = float(np.mean(rates))
+    img_sec_per_chip = img_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(img_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            img_sec_per_chip / BASELINE_IMG_PER_SEC_PER_WORKER, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

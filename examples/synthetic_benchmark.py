@@ -1,0 +1,105 @@
+"""Synthetic ResNet benchmark — parity with the reference harness
+(examples/pytorch_synthetic_benchmark.py: --model, --batch-size,
+--num-warmup-batches 10, --num-iters 10, --num-batches-per-iter 10; prints
+img/sec per worker and total with stddev).
+
+TPU-native: bf16 compute, NHWC, one fused gradient psum per bucket inside a
+single compiled train step.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import trainer
+from horovod_tpu.models import resnet
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=sorted(resnet.MODELS))
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-worker batch size (reference default 32)")
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="bf16 compression on gradient allreduce")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    world = hvd.size()
+    batch = args.batch_size * world
+
+    model = resnet.MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16)
+    images = jnp.zeros((batch, args.image_size, args.image_size, 3),
+                       jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    compression = (hvd.Compression.bf16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  compression=compression)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, b):
+        imgs, lbls = b
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": batch_stats}, imgs, train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, lbls[:, None], axis=-1))
+
+    step = trainer.make_data_parallel_step(loss_fn, tx, hvd.mesh(),
+                                           compression=compression,
+                                           donate=False)
+    sharding = NamedSharding(hvd.mesh(), P(hvd.mesh().axis_names[0]))
+    images = jax.device_put(images, sharding)
+    labels = jax.device_put(labels, sharding)
+
+    if hvd.process_rank() == 0:
+        print(f"Model: {args.model}")
+        print(f"Batch size: {args.batch_size} per worker x {world} workers")
+
+    for _ in range(max(1, args.num_warmup_batches // 10)):
+        params, opt_state, loss = step(params, opt_state, (images, labels))
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state,
+                                           (images, labels))
+        jax.block_until_ready(loss)
+        rate = batch * args.num_batches_per_iter / (time.perf_counter() - t0)
+        img_secs.append(rate / world)
+        if hvd.process_rank() == 0:
+            print(f"Iter #{i}: {rate / world:.1f} img/sec per worker")
+
+    if hvd.process_rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per worker: {mean:.1f} +-{conf:.1f}")
+        print(f"Total img/sec on {world} worker(s): "
+              f"{mean * world:.1f} +-{conf * world:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""MNIST CNN — the minimum end-to-end model (SURVEY.md §7 slice 1).
+
+Architecture parity with the reference example's Net
+(examples/pytorch_mnist.py: two conv layers + dropout + two FC layers), but
+written as a flax module with NHWC layout and bf16-friendly compute, which is
+what the TPU MXU wants.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """conv(10,5x5) → maxpool → conv(20,5x5) → dropout → maxpool →
+    fc(50) → fc(10), matching the reference Net's shape."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(50, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(10, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

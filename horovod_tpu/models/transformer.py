@@ -1,0 +1,190 @@
+"""Decoder-only transformer LM — the flagship long-context/distributed model.
+
+The reference has no transformer (it predates them; SURVEY.md §5 notes
+sequence parallelism is absent upstream), but BASELINE.json's configs include
+a Llama-style LM, and long-context + multi-axis parallelism are first-class
+requirements for the TPU build. Design is TPU-first:
+
+  * bf16 compute, fp32 params (MXU-native mixed precision)
+  * large fused matmuls (qkv in one projection; gated MLP in two)
+  * static shapes, no data-dependent control flow — jit-clean
+  * Megatron-style tensor parallelism expressed as GSPMD shardings:
+    column-parallel qkv/ffn-in kernels on 'tp', row-parallel out/ffn-out on
+    'tp' (param_specs below); XLA inserts the all-reduces on ICI
+  * sequence axis shardable on 'sp' (ring attention in parallel/ring.py
+    gives the O(seq) comm path for long context)
+  * optional remat (jax.checkpoint) per block to trade FLOPs for HBM
+"""
+
+import dataclasses
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(vocab_size=256, num_layers=2, num_heads=4, d_model=64,
+                   d_ff=256, max_seq_len=128, **kw)
+
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(vocab_size=50304, num_layers=12, num_heads=12,
+                   d_model=768, d_ff=3072, max_seq_len=1024, **kw)
+
+    @classmethod
+    def llama_1b(cls, **kw):
+        return cls(vocab_size=32000, num_layers=16, num_heads=16,
+                   d_model=2048, d_ff=8192, max_seq_len=4096, **kw)
+
+
+def _rope(x, positions):
+    """Rotary position embedding (applied per head)."""
+    *_, seq, head_dim = x.shape
+    half = head_dim // 2
+    freq = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freq  # [.., seq, half]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin,
+                               x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.num_heads
+        # One fused qkv projection: a single large matmul keeps the MXU busy.
+        qkv = nn.Dense(3 * cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (cfg.num_heads, head_dim))
+        q, k, v = map(heads, (q, k, v))  # [b, s, h, d]
+        q = _rope(q.swapaxes(1, 2), positions).swapaxes(1, 2)
+        k = _rope(k.swapaxes(1, 2), positions).swapaxes(1, 2)
+        scale = head_dim ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        seq = x.shape[1]
+        mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        probs = probs.astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(out.shape[:2] + (cfg.d_model,))
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="out")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        # Gated (SwiGLU-style) MLP: two column-parallel matmuls + one
+        # row-parallel.
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                        name="gate")(x)
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                      name="up")(x)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        y = nn.RMSNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        x = x + Attention(cfg, name="attn")(y, positions)
+        y = nn.RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x)
+        x = x + MLP(cfg, name="mlp")(y)
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model,
+                     dtype=cfg.dtype, name="embed")(tokens)
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions)
+        x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules: Megatron-style TP expressed as GSPMD PartitionSpecs.
+# ---------------------------------------------------------------------------
+
+_TP_RULES = (
+    # (path suffix, spec) — first match wins.
+    (("attn", "qkv", "kernel"), P(None, "tp")),      # column parallel
+    (("attn", "out", "kernel"), P("tp", None)),      # row parallel
+    (("mlp", "gate", "kernel"), P(None, "tp")),
+    (("mlp", "up", "kernel"), P(None, "tp")),
+    (("mlp", "down", "kernel"), P("tp", None)),
+    (("lm_head", "kernel"), P(None, "tp")),          # vocab-sharded head
+    (("embed", "embedding"), P(None, None)),
+)
+
+
+def param_specs(params):
+    """PartitionSpec pytree for tensor-parallel parameter placement.
+
+    Unmatched leaves are replicated. Feed to
+    jax.jit(in_shardings=...)/NamedSharding over a mesh with a 'tp' axis.
+    """
+    def spec_for(path, leaf):
+        names = tuple(str(getattr(p, "key", getattr(p, "name", p)))
+                      for p in path)
+        for suffix, spec in _TP_RULES:
+            if names[-len(suffix):] == suffix:
+                return spec
+        return P()
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def batch_spec(sp=False):
+    """Activation sharding for [batch, seq] token arrays: batch over 'dp',
+    sequence over 'sp' when sequence parallelism is on."""
+    return P("dp", "sp" if sp else None)
+
+
+def init_params(cfg, rng, batch_size=2, seq_len=None):
+    model = TransformerLM(cfg)
+    seq_len = seq_len or min(cfg.max_seq_len, 128)
+    tokens = jnp.zeros((batch_size, seq_len), jnp.int32)
+    return model, model.init(rng, tokens)["params"]

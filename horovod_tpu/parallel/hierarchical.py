@@ -1,0 +1,64 @@
+"""Two-level (hierarchical) collectives: ICI intra-slice + DCN inter-slice.
+
+TPU-native re-design of NCCLHierarchicalAllreduce
+(reference horovod/common/ops/nccl_operations.cc:162-379), which does:
+
+    intra-node ncclReduceScatter (:269) + remainder ncclReduce (:283)
+    → D2H copy → cross-node MPI_Allreduce on the CROSS comm (:314)
+    → H2D → intra-node ncclAllGather (:334) + ncclBcast (:343)
+
+with local_size-divisible padding (:210-216). The TPU analogue keeps the
+algorithm — reduce-scatter over the fast axis, allreduce over the slow axis,
+all-gather over the fast axis — but as three XLA collectives inside one
+compiled program, no host staging: XLA routes the 'chips' axis over ICI and
+the 'slices' axis over DCN based on the mesh layout.
+
+The bandwidth argument is identical to the NCCL case: the inter-slice
+allreduce moves only 1/chips_per_slice of the data per chip.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def hierarchical_allreduce(tensor, fast_axis="chips", slow_axis="slices",
+                           average=False):
+    """reduce_scatter(fast) → psum(slow) → all_gather(fast).
+
+    Call inside shard_map over a 2-axis mesh (see
+    parallel/mesh.py:build_hierarchical_mesh). Works on any tensor shape;
+    the scatter dimension is a flattened, padded view (padding parity:
+    nccl_operations.cc:210-216).
+    """
+    fast_size = lax.axis_size(fast_axis)
+    orig_shape = tensor.shape
+    flat = jnp.ravel(tensor)
+    n = flat.shape[0]
+    padded = -(-n // fast_size) * fast_size
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    # Phase 1: reduce-scatter over the fast (ICI) axis — each chip owns a
+    # 1/fast_size shard of the slice-local sum.
+    shard = lax.psum_scatter(flat, fast_axis, tiled=True)
+    # Phase 2: allreduce the small shard over the slow (DCN) axis.
+    shard = lax.psum(shard, slow_axis)
+    # Phase 3: all-gather over the fast axis to rebuild the full tensor.
+    full = lax.all_gather(shard, fast_axis, tiled=True)
+    if padded != n:
+        full = full[:n]
+    out = jnp.reshape(full, orig_shape)
+    if average:
+        out = out / (fast_size * lax.axis_size(slow_axis))
+    return out
+
+
+def flat_allreduce(tensor, axes, average=False):
+    """Single-phase psum over one or more axes (the non-hierarchical path;
+    reference NCCLAllreduce, nccl_operations.cc:53-160)."""
+    out = lax.psum(tensor, axes)
+    if average:
+        size = 1
+        for a in (axes if isinstance(axes, (tuple, list)) else [axes]):
+            size *= lax.axis_size(a)
+        out = out / size
+    return out

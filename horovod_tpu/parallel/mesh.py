@@ -1,0 +1,90 @@
+"""Device-mesh construction for every parallelism strategy.
+
+The reference supports exactly one strategy — synchronous data parallelism
+over MPI ranks (SURVEY.md §2.6) — with a two-level intra/inter-node variant
+(NCCLHierarchicalAllreduce, nccl_operations.cc:162-379). On TPU the mesh is
+the first-class object: all strategies (dp/fsdp/tp/pp/sp/ep) are axes of one
+``jax.sharding.Mesh`` and XLA lowers collectives onto ICI (intra-slice) and
+DCN (inter-slice) links according to the axis layout.
+
+Axis conventions (leading axis first → slowest-varying over the device
+order, which on multi-slice topologies means the DCN dimension):
+
+  dp  — data parallel (gradient allreduce; the Horovod axis)
+  pp  — pipeline parallel (stage dimension)
+  tp  — tensor/model parallel (weight shards; activation collectives)
+  sp  — sequence/context parallel (ring attention / all-to-all)
+  ep  — expert parallel (MoE dispatch)
+"""
+
+import collections
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "tp", "sp", "ep")
+
+
+def build_mesh(dp=None, pp=1, tp=1, sp=1, ep=1, devices=None,
+               axis_order=AXES):
+    """Build a 5-axis mesh; unknown ``dp`` is inferred from device count.
+
+    Size-1 axes are kept so code can be written against the full axis set
+    regardless of the actual factorization (collectives over a size-1 axis
+    are free).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = {"pp": pp, "tp": tp, "sp": sp, "ep": ep}
+    explicit = pp * tp * sp * ep
+    if dp is None:
+        if n % explicit != 0:
+            raise ValueError(
+                f"{n} devices not divisible by pp*tp*sp*ep={explicit}")
+        dp = n // explicit
+    sizes["dp"] = dp
+    total = dp * explicit
+    if total != n:
+        raise ValueError(
+            f"Mesh {sizes} needs {total} devices, have {n}")
+    shape = tuple(sizes[a] for a in axis_order)
+    return Mesh(np.asarray(devices).reshape(shape), axis_order)
+
+
+def build_hierarchical_mesh(num_slices, devices=None,
+                            axis_names=("slices", "chips")):
+    """Two-level mesh: inter-slice (DCN) x intra-slice (ICI).
+
+    The analogue of the reference's LOCAL/CROSS communicator split
+    (MPI_Comm_split_type SHARED + cross split, operations.cc:890-959):
+    ``chips`` is the fast intra-slice axis, ``slices`` the slow inter-slice
+    axis. Used by the hierarchical allreduce (parallel/hierarchical.py).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % num_slices != 0:
+        raise ValueError(f"{n} devices not divisible into {num_slices} slices")
+    arr = np.asarray(devices).reshape(num_slices, n // num_slices)
+    return Mesh(arr, axis_names)
+
+
+def infer_slice_structure(devices=None):
+    """Group devices by their physical slice/host so the hierarchical path
+    can lay the slow axis over DCN. Falls back to a single slice when the
+    platform exposes no slice/process structure."""
+    if devices is None:
+        devices = jax.devices()
+    groups = collections.defaultdict(list)
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = getattr(d, "process_index", 0)
+        groups[key].append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def mesh_axis_size(mesh, name):
+    return mesh.shape[name] if name in mesh.shape else 1

@@ -1,0 +1,89 @@
+"""Checkpoint / resume primitives.
+
+The reference does checkpointing at the app level (save model+optimizer+epoch
+on rank 0, reload and broadcast on restart — examples/pytorch_mnist.py:
+175-195 and save_model around :305-312); the framework's contribution is the
+consistency primitive (broadcast_parameters / broadcast_optimizer_state,
+torch/__init__.py:200-348). Here checkpointing is in-framework:
+
+  * ``save(path, tree, step)`` — atomic (write-temp + rename) host-side
+    save of any pytree (params, optimizer state, anything), rank-0 only by
+    default — exactly-once semantics for elastic restart.
+  * ``restore(path)`` — load and return (tree, step); feed through
+    ``broadcast_parameters`` to fan out to all workers.
+
+Format: a directory with a numpy .npz of flattened leaves + a JSON treedef
+descriptor. Self-contained (no orbax dependency) so the elastic supervisor
+can reason about it; orbax remains available for users who want async
+multi-host checkpointing.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+
+import jax
+import numpy as np
+
+_MANIFEST = "manifest.json"
+_ARRAYS = "arrays.npz"
+
+
+def _flatten_with_names(tree):
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    names, leaves = [], []
+    for path, leaf in flat:
+        names.append(jax.tree_util.keystr(path))
+        leaves.append(leaf)
+    return names, leaves
+
+
+def save(path, tree, step=0, force_all_processes=False):
+    """Atomically save a pytree checkpoint. Rank-0 (process 0) writes;
+    other processes no-op unless force_all_processes."""
+    if jax.process_index() != 0 and not force_all_processes:
+        return path
+    names, leaves = _flatten_with_names(tree)
+    treedef = jax.tree_util.tree_structure(tree)
+    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-",
+                           dir=os.path.dirname(os.path.abspath(path)) or ".")
+    try:
+        arrays = {str(i): np.asarray(leaf) for i, leaf in enumerate(leaves)}
+        np.savez(os.path.join(tmp, _ARRAYS), **arrays)
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump({"step": int(step), "names": names,
+                       "treedef": str(treedef), "n": len(leaves)}, f)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return path
+
+
+def restore(path, like=None):
+    """Load a checkpoint → (tree, step). ``like`` supplies the treedef to
+    rebuild into (required for custom pytree nodes); without it a flat
+    {name: array} dict is returned."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    with np.load(os.path.join(path, _ARRAYS)) as data:
+        leaves = [data[str(i)] for i in range(manifest["n"])]
+    if like is not None:
+        treedef = jax.tree_util.tree_structure(like)
+        return jax.tree_util.tree_unflatten(treedef, leaves), manifest["step"]
+    return dict(zip(manifest["names"], leaves)), manifest["step"]
+
+
+def exists(path):
+    return (os.path.isdir(path) and
+            os.path.exists(os.path.join(path, _MANIFEST)))
+
+
+def latest_step(path):
+    if not exists(path):
+        return None
+    with open(os.path.join(path, _MANIFEST)) as f:
+        return json.load(f)["step"]
