@@ -54,9 +54,7 @@ def main():
         logits, _ = model.apply(
             {"params": p, "batch_stats": batch_stats}, imgs, train=True,
             mutable=["batch_stats"])
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, lbls[:, None],
-                                             axis=-1))
+        return trainer.softmax_cross_entropy(logits, lbls)
 
     step = trainer.make_data_parallel_step(loss_fn, tx, mesh, donate=False)
     data_sharding = jax.sharding.NamedSharding(

@@ -94,14 +94,17 @@ def main():
     opt_state = hvd.broadcast_optimizer_state(opt_state)
 
     def loss_fn(p, batch):
-        imgs, labels = batch
-        logits = model.apply({"params": p}, imgs, train=False)
-        logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+        imgs, labels, dropout_key = batch
+        # per-worker dropout mask: fold the worker rank into the step key
+        rngs = {"dropout": jax.random.fold_in(dropout_key, hvd.rank())}
+        logits = model.apply({"params": p}, imgs, train=True, rngs=rngs)
+        return trainer.softmax_cross_entropy(logits, labels)
 
-    step = trainer.make_data_parallel_step(loss_fn, tx, hvd.mesh(),
-                                           donate=False)
-    sharding = NamedSharding(hvd.mesh(), P(hvd.mesh().axis_names[0]))
+    axis = hvd.mesh().axis_names[0]
+    step = trainer.make_data_parallel_step(
+        loss_fn, tx, hvd.mesh(), donate=False,
+        batch_specs=(P(axis), P(axis), P()))
+    sharding = NamedSharding(hvd.mesh(), P(axis))
 
     steps_per_epoch = args.steps_per_epoch or max(1, len(X) // global_batch)
     rng = np.random.RandomState(args.seed)
@@ -115,7 +118,9 @@ def main():
                 idx = np.resize(idx, global_batch)
             imgs = jax.device_put(jnp.asarray(X[idx]), sharding)
             labels = jax.device_put(jnp.asarray(Y[idx]), sharding)
-            params, opt_state, loss = step(params, opt_state, (imgs, labels))
+            key = jax.random.PRNGKey(args.seed * 100003 + epoch * 1000 + i)
+            params, opt_state, loss = step(params, opt_state,
+                                           (imgs, labels, key))
             epoch_loss.append(float(loss))
         # epoch metric averaged across workers (MetricAverageCallback parity)
         avg = float(hvd.allreduce(np.float32(np.mean(epoch_loss))))

@@ -64,8 +64,7 @@ def main():
         logits, _ = model.apply(
             {"params": p, "batch_stats": batch_stats}, imgs, train=True,
             mutable=["batch_stats"])
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, lbls[:, None], axis=-1))
+        return trainer.softmax_cross_entropy(logits, lbls)
 
     step = trainer.make_data_parallel_step(loss_fn, tx, hvd.mesh(),
                                            compression=compression,
@@ -78,7 +77,7 @@ def main():
         print(f"Model: {args.model}")
         print(f"Batch size: {args.batch_size} per worker x {world} workers")
 
-    for _ in range(max(1, args.num_warmup_batches // 10)):
+    for _ in range(args.num_warmup_batches):
         params, opt_state, loss = step(params, opt_state, (images, labels))
     jax.block_until_ready(loss)
 

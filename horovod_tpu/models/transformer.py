@@ -36,6 +36,11 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # 'full' (default), 'ring', or 'ulysses': how attention handles a
+    # sequence-sharded input. ring/ulysses take effect when the model runs
+    # inside shard_map with the 'sp' axis bound (parallel/ring.py); under
+    # plain GSPMD jit the full path is used and XLA inserts gathers.
+    attention_impl: str = "full"
 
     @classmethod
     def tiny(cls, **kw):
@@ -53,6 +58,38 @@ class TransformerConfig:
                    d_model=2048, d_ff=8192, max_seq_len=4096, **kw)
 
 
+def _active_sp_axis(tokens):
+    """'sp' iff the model runs inside shard_map with the 'sp' axis bound AND
+    the token array actually varies over it (i.e. the sequence is sharded,
+    not merely replicated across an sp axis that happens to be in the mesh).
+    Keying on real sharding rather than axis binding avoids both
+    wrong-global-positions on replicated data and silent local-only
+    attention on sharded data."""
+    from ..ops.collective_ops import _bound_axis_names
+    if "sp" not in _bound_axis_names():
+        return None
+    varying = getattr(getattr(tokens, "aval", None), "vma", frozenset())
+    return "sp" if "sp" in varying else None
+
+
+def _dispatch_attention(cfg, q, k, v, sp):
+    """Pick the attention algorithm for this context. ``sp`` is the active
+    sequence-sharding axis (None when the sequence is whole on this
+    worker)."""
+    from ..parallel import ring
+    if sp is not None:
+        if cfg.attention_impl == "ring":
+            return ring.ring_attention(q, k, v, axis_name=sp, causal=True)
+        if cfg.attention_impl == "ulysses":
+            return ring.ulysses_attention(q, k, v, axis_name=sp, causal=True)
+        raise ValueError(
+            "The sequence is sharded over the 'sp' mesh axis but "
+            f"attention_impl={cfg.attention_impl!r} cannot attend across "
+            "shards — construct the model with attention_impl='ring' or "
+            "'ulysses' for sequence parallelism.")
+    return ring.full_attention(q, k, v, causal=True)
+
+
 def _rope(x, positions):
     """Rotary position embedding (applied per head)."""
     *_, seq, head_dim = x.shape
@@ -68,6 +105,7 @@ def _rope(x, positions):
 
 class Attention(nn.Module):
     cfg: TransformerConfig
+    sp: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -83,14 +121,7 @@ class Attention(nn.Module):
         q, k, v = map(heads, (q, k, v))  # [b, s, h, d]
         q = _rope(q.swapaxes(1, 2), positions).swapaxes(1, 2)
         k = _rope(k.swapaxes(1, 2), positions).swapaxes(1, 2)
-        scale = head_dim ** -0.5
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        seq = x.shape[1]
-        mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        probs = probs.astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = _dispatch_attention(cfg, q, k, v, self.sp)
         out = out.reshape(out.shape[:2] + (cfg.d_model,))
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         name="out")(out)
@@ -114,12 +145,13 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    sp: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
         y = nn.RMSNorm(dtype=cfg.dtype, name="ln_attn")(x)
-        x = x + Attention(cfg, name="attn")(y, positions)
+        x = x + Attention(cfg, sp=self.sp, name="attn")(y, positions)
         y = nn.RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x)
         x = x + MLP(cfg, name="mlp")(y)
         return x
@@ -133,12 +165,19 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model,
                      dtype=cfg.dtype, name="embed")(tokens)
-        positions = jnp.arange(tokens.shape[1])[None, :]
+        s_loc = tokens.shape[1]
+        sp = _active_sp_axis(tokens)
+        if sp is not None:
+            # sequence-sharded input: positions are global
+            offset = jax.lax.axis_index(sp) * s_loc
+        else:
+            offset = 0
+        positions = (offset + jnp.arange(s_loc))[None, :]
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions)
+            x = block(cfg, sp=sp, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           name="lm_head")(x)

@@ -1,0 +1,157 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has none of this (SURVEY.md §5: 'Long-context / sequence
+parallelism: Absent') — the only ring there is the ring-allreduce inside
+MPI/NCCL. For the TPU build, long context is first-class: these primitives
+shard the *sequence* dimension across the 'sp' mesh axis so attention over
+sequences far larger than one chip's HBM runs with O(seq/sp) memory and
+overlapped ICI communication.
+
+* ``ring_attention`` — blockwise causal attention with online softmax
+  (flash-attention accumulation), passing K/V blocks around the ring with
+  ``lax.ppermute``. Comm volume per step is one K/V block over ICI, fully
+  overlappable with the block matmul: the TPU-native analogue of the
+  ring-allreduce pipelining idea the reference gets from NCCL.
+* ``ulysses_attention`` — all-to-all sequence→head reshard, local full
+  attention, head→sequence reshard back (DeepSpeed-Ulysses style). Cheaper
+  at moderate sequence lengths; needs num_heads % sp == 0.
+
+Both are pure jax and run inside shard_map over the 'sp' axis.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One q-block x k-block attention with fp32 logits.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: [sq, sk] bool or None.
+    Returns (scores_max [b,h,sq], exp_sums [b,h,sq], out [b,sq,h,d*fp32]).
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)                        # [b,h,q]
+    p = jnp.exp(logits - m[..., None])                  # [b,h,q,k]
+    l = jnp.sum(p, axis=-1)                             # [b,h,q]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return m, l, o
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=True):
+    """Blockwise ring attention over the sequence-parallel axis.
+
+    Args:
+      q, k, v: per-shard [batch, seq_local, heads, head_dim]; the global
+        sequence is the concatenation of shards along the axis in rank
+        order.
+      axis_name: mesh axis carrying the sequence shards.
+      causal: apply a causal mask in *global* positions.
+
+    Returns per-shard attention output [batch, seq_local, heads, head_dim]
+    with exact (non-approximate) softmax, accumulated in fp32.
+    """
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = d ** -0.5
+    q_pos = my_idx * s_loc + jnp.arange(s_loc)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        # the block currently held arrived from rank (my_idx - i) mod W
+        src = (my_idx - i) % axis_size
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        bm, bl, bo = _block_attn(q, k_cur, v_cur, mask, scale)
+        # online softmax merge (flash accumulation)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l_new = l * alpha + bl * beta
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None] +
+                 bo * beta.transpose(0, 2, 1)[..., None])
+        # rotate K/V to the next rank; XLA overlaps this with the matmuls
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    if hasattr(lax, "pcast"):
+        # The loop carry must have consistent varying-manual-axes types
+        # (jax>=0.8): accumulators start unvarying, and k/v may be varying
+        # over fewer axes than the loop body produces (ppermute adds the
+        # ring axis; q's mask/merge add any other bound axes). Cast
+        # everything in the carry to varying over all bound axes.
+        from ..ops.collective_ops import _bound_axis_names
+        axes = tuple(_bound_axis_names())
+
+        def vary(t):
+            have = getattr(getattr(t, "aval", None), "vma", frozenset())
+            missing = tuple(a for a in axes if a not in have)
+            return lax.pcast(t, missing, to="varying") if missing else t
+        o0, m0, l0, k, v = map(vary, (o0, m0, l0, k, v))
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=True,
+                      attn_fn=None):
+    """All-to-all sequence parallelism (Ulysses).
+
+    Reshards [b, s/W, H, d] → [b, s, H/W, d] with one all-to-all, runs full
+    (local) attention over the complete sequence on each rank's head slice,
+    and reshards back. The alltoall primitive is the one the public API
+    exposes (mpi_ops.alltoall).
+    """
+    axis_size = lax.axis_size(axis_name)
+    h = q.shape[2]
+    assert h % axis_size == 0, (
+        f"num_heads {h} must divide the sp axis size {axis_size}")
+
+    def seq_to_heads(t):
+        # [b, s_loc, h, d] -> [b, s_loc*W, h/W, d]
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = map(seq_to_heads, (q, k, v))
+    if attn_fn is None:
+        out = full_attention(qg, kg, vg, causal=causal)
+    else:
+        out = attn_fn(qg, kg, vg)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def full_attention(q, k, v, causal=True):
+    """Single-device reference attention (for tests and the sp=1 path)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)

@@ -33,7 +33,8 @@ def softmax_cross_entropy(logits, labels):
 
 def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
                             compression=Compression.none,
-                            fusion_threshold=None, donate=True):
+                            fusion_threshold=None, donate=True,
+                            batch_specs=None):
     """Compiled Horovod-style train step.
 
     ``loss_fn(params, batch) -> scalar`` is the per-worker loss on the
@@ -54,7 +55,10 @@ def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
         mean_loss = jax.lax.pmean(loss, axis)
         return params, opt_state, mean_loss
 
-    batch_spec = P(axis)
+    # batch_specs: PartitionSpec pytree for the batch argument (per-leaf),
+    # default: shard every leaf's leading dim over the worker axis.
+    # Replicated leaves (e.g. an rng key) use P().
+    batch_spec = batch_specs if batch_specs is not None else P(axis)
     step = jax.shard_map(
         per_worker, mesh=mesh,
         in_specs=(P(), P(), batch_spec),

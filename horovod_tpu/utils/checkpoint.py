@@ -54,9 +54,17 @@ def save(path, tree, step=0, force_all_processes=False):
         with open(os.path.join(tmp, _MANIFEST), "w") as f:
             json.dump({"step": int(step), "names": names,
                        "treedef": str(treedef), "n": len(leaves)}, f)
+        # Crash-safe overwrite: park the old checkpoint at <path>.old, then
+        # rename the new one in. At every instant either <path> or
+        # <path>.old holds a complete checkpoint; restore() falls back to
+        # .old if a crash hit between the two renames.
+        old = path + ".old"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
         if os.path.isdir(path):
-            shutil.rmtree(path)
+            os.replace(path, old)
         os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -66,7 +74,11 @@ def save(path, tree, step=0, force_all_processes=False):
 def restore(path, like=None):
     """Load a checkpoint → (tree, step). ``like`` supplies the treedef to
     rebuild into (required for custom pytree nodes); without it a flat
-    {name: array} dict is returned."""
+    {name: array} dict is returned. Falls back to <path>.old if a crash
+    interrupted an overwrite mid-rename."""
+    if not os.path.exists(os.path.join(path, _MANIFEST)) and \
+            os.path.exists(os.path.join(path + ".old", _MANIFEST)):
+        path = path + ".old"
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
     with np.load(os.path.join(path, _ARRAYS)) as data:
@@ -78,8 +90,8 @@ def restore(path, like=None):
 
 
 def exists(path):
-    return (os.path.isdir(path) and
-            os.path.exists(os.path.join(path, _MANIFEST)))
+    return (os.path.exists(os.path.join(path, _MANIFEST)) or
+            os.path.exists(os.path.join(path + ".old", _MANIFEST)))
 
 
 def latest_step(path):

@@ -48,3 +48,18 @@ def test_restore_then_broadcast(hvd, tmp_path):
     restored, _ = checkpoint.restore(path, like=params)
     synced = hvd.broadcast_parameters(restored)
     np.testing.assert_allclose(np.asarray(synced["k"]), np.full((4,), 3.0))
+
+
+def test_restore_falls_back_to_old_after_interrupted_overwrite(hvd, tmp_path):
+    """Crash between the two renames leaves <path>.old — restore must use
+    it (crash-safe overwrite semantics for elastic restart)."""
+    import os
+    from horovod_tpu.utils import checkpoint
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, {"x": np.full(2, 1.0)}, step=1)
+    # simulate the crash window: old parked, new never installed
+    os.replace(path, path + ".old")
+    assert checkpoint.exists(path)
+    restored, step = checkpoint.restore(path, like={"x": np.zeros(2)})
+    assert step == 1
+    np.testing.assert_allclose(restored["x"], np.full(2, 1.0))

@@ -1,0 +1,132 @@
+"""Transformer with sequence parallelism: shard_map('sp') forward with ring
+attention must match the single-device full-attention forward."""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_forward_matches_full(hvd, impl):
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab_size=64, num_layers=2, num_heads=8,
+                               d_model=32, d_ff=64, max_seq_len=64,
+                               dtype=jnp.float32, attention_impl=impl)
+    model = tr.TransformerLM(cfg)
+    tokens = np.random.RandomState(0).randint(0, 64, (2, 64)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))["params"]
+
+    # single-device reference (full attention path)
+    full_logits = model.apply({"params": params}, jnp.asarray(tokens))
+
+    mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+    sp_logits = jax.jit(jax.shard_map(
+        lambda p, t: model.apply({"params": p}, t),
+        mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp")))(params, jnp.asarray(tokens))
+
+    np.testing.assert_allclose(np.asarray(sp_logits),
+                               np.asarray(full_logits), rtol=2e-4, atol=2e-4)
+
+
+def test_sp_training_step(hvd):
+    """One dp x sp training step with ring attention: loss finite, grads
+    flow."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu import trainer
+    from horovod_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab_size=64, num_layers=1, num_heads=4,
+                               d_model=16, d_ff=32, max_seq_len=32,
+                               dtype=jnp.float32, attention_impl="ring")
+    model = tr.TransformerLM(cfg)
+    tokens = np.random.RandomState(1).randint(0, 64, (4, 33)).astype(np.int32)
+    # shift globally BEFORE sharding the sequence over sp
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(inputs))["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    devices = np.asarray(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "sp"))
+
+    def step(p, s, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            return trainer.softmax_cross_entropy(logits, y)
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        grads = jax.lax.pmean(grads, ("dp", "sp"))
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, jax.lax.pmean(
+            loss, ("dp", "sp"))
+
+    # batch sharded over dp AND sequence sharded over sp: each worker holds
+    # a [2, 8] tile; ring attention runs globally over sp
+    out = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), P(), P())))(params, opt_state, jnp.asarray(inputs),
+                                    jnp.asarray(labels))
+    params2, _, loss = out
+    assert np.isfinite(float(loss))
+    changed = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(np.asarray(a), np.asarray(b)),
+        params, params2)
+    assert any(jax.tree_util.tree_leaves(changed))
+
+
+def test_full_attention_errors_on_sharded_sequence(hvd):
+    """attention_impl='full' with a genuinely sp-sharded sequence must raise,
+    not silently compute shard-local attention."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                               d_model=16, d_ff=32, dtype=jnp.float32,
+                               attention_impl="full")
+    model = tr.TransformerLM(cfg)
+    toks = np.zeros((2, 64), np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(toks))["params"]
+    mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+    with pytest.raises(ValueError, match="sharded over the 'sp'"):
+        jax.jit(jax.shard_map(
+            lambda p, t: model.apply({"params": p}, t), mesh=mesh,
+            in_specs=(P(), P(None, "sp")),
+            out_specs=P(None, "sp")))(params, jnp.asarray(toks))
+
+
+def test_replicated_sequence_with_sp_bound_uses_full_path(hvd):
+    """With sp bound but the sequence replicated, the model must produce the
+    same result on every sp rank (no bogus global-position offsets)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                               d_model=16, d_ff=32, dtype=jnp.float32,
+                               attention_impl="ring")
+    model = tr.TransformerLM(cfg)
+    toks = np.random.RandomState(0).randint(0, 32, (2, 16)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(toks))["params"]
+    ref = model.apply({"params": params}, jnp.asarray(toks))
+    mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+    # replicated input with out_specs=P(): shard_map itself verifies the
+    # output is sp-invariant — if the model wrongly used axis_index('sp')
+    # on replicated data this fails to trace
+    out = jax.jit(jax.shard_map(
+        lambda p, t: model.apply({"params": p}, t),
+        mesh=mesh, in_specs=(P(), P()),
+        out_specs=P()))(params, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
