@@ -1,0 +1,124 @@
+"""ctypes binding to the native runtime core (libhvd_core.so).
+
+The reference loads its native core the same way — ctypes.CDLL on the built
+extension (horovod/common/basics.py:25-28, util.py check_extension). Build
+with ``python setup.py build_native`` (or the Makefile in this directory);
+if the library is absent or fails to load, ``LIB`` is None and callers fall
+back to the pure-Python implementations, so the framework works (slower)
+without a toolchain.
+"""
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libhvd_core.so")
+
+LIB = None
+_LOAD_FAILED = False  # negative cache: never retry a failed build/load
+
+
+def _configure(lib):
+    c = ctypes
+    lib.hvd_core_version.restype = c.c_char_p
+    lib.hvd_log.argtypes = [c.c_int, c.c_char_p]
+    lib.hvd_log_set_level.argtypes = [c.c_int]
+    lib.hvd_log_get_level.restype = c.c_int
+
+    lib.hvd_plan_buckets.restype = c.c_int64
+    lib.hvd_plan_buckets.argtypes = [
+        c.c_int64, c.POINTER(c.c_int64), c.POINTER(c.c_int32), c.c_int64,
+        c.POINTER(c.c_int32)]
+
+    lib.hvd_cache_create.restype = c.c_void_p
+    lib.hvd_cache_create.argtypes = [c.c_int64]
+    lib.hvd_cache_destroy.argtypes = [c.c_void_p]
+    lib.hvd_cache_lookup.restype = c.c_int64
+    lib.hvd_cache_lookup.argtypes = [c.c_void_p, c.c_uint64]
+    lib.hvd_cache_insert.argtypes = [c.c_void_p, c.c_uint64, c.c_int64]
+    for fn in (lib.hvd_cache_hits, lib.hvd_cache_misses, lib.hvd_cache_size):
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_void_p]
+    lib.hvd_cache_clear.argtypes = [c.c_void_p]
+
+    lib.hvd_table_create.restype = c.c_void_p
+    lib.hvd_table_destroy.argtypes = [c.c_void_p]
+    lib.hvd_table_add.restype = c.c_int
+    lib.hvd_table_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                  c.c_double]
+    lib.hvd_table_remove.restype = c.c_int
+    lib.hvd_table_remove.argtypes = [c.c_void_p, c.c_char_p]
+    lib.hvd_table_count.restype = c.c_int64
+    lib.hvd_table_count.argtypes = [c.c_void_p]
+    lib.hvd_table_stalled.restype = c.c_int64
+    lib.hvd_table_stalled.argtypes = [c.c_void_p, c.c_double, c.c_double,
+                                      c.c_char_p, c.c_int64]
+
+    lib.hvd_timeline_create.restype = c.c_void_p
+    lib.hvd_timeline_create.argtypes = [c.c_char_p, c.c_int]
+    lib.hvd_timeline_destroy.argtypes = [c.c_void_p]
+    lib.hvd_timeline_event.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                       c.c_int]
+    lib.hvd_timeline_cycle.argtypes = [c.c_void_p]
+    lib.hvd_timeline_pending.restype = c.c_int64
+    lib.hvd_timeline_pending.argtypes = [c.c_void_p]
+
+    lib.hvd_autotune_create.restype = c.c_void_p
+    lib.hvd_autotune_create.argtypes = [c.c_double, c.c_double, c.c_double,
+                                        c.c_double, c.c_uint64]
+    lib.hvd_autotune_destroy.argtypes = [c.c_void_p]
+    lib.hvd_autotune_record.argtypes = [c.c_void_p, c.c_double, c.c_double,
+                                        c.c_double]
+    lib.hvd_autotune_suggest.argtypes = [c.c_void_p, c.POINTER(c.c_double),
+                                         c.POINTER(c.c_double)]
+    lib.hvd_autotune_num_samples.restype = c.c_int64
+    lib.hvd_autotune_num_samples.argtypes = [c.c_void_p]
+    lib.hvd_autotune_best.restype = c.c_int
+    lib.hvd_autotune_best.argtypes = [c.c_void_p, c.POINTER(c.c_double),
+                                      c.POINTER(c.c_double),
+                                      c.POINTER(c.c_double)]
+
+    lib.hvd_hash_bytes.restype = c.c_uint64
+    lib.hvd_hash_bytes.argtypes = [c.c_void_p, c.c_int64]
+    return lib
+
+
+def build(force=False):
+    """Compile libhvd_core.so with g++ (no external deps)."""
+    src_dir = os.path.join(_DIR, "src")
+    sources = [os.path.join(src_dir, f) for f in
+               ("hvd_core.cc", "timeline.cc", "autotune.cc")]
+    if not force and os.path.exists(_LIB_PATH):
+        newest_src = max(os.path.getmtime(s) for s in sources)
+        if os.path.getmtime(_LIB_PATH) >= newest_src:
+            return _LIB_PATH
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-fvisibility=hidden", "-o", _LIB_PATH] + sources
+    subprocess.run(cmd, check=True)
+    return _LIB_PATH
+
+
+def load(auto_build=True):
+    """Load (building if needed) the native core; returns the lib or None.
+    A failed build/load is cached so the hot path never re-spawns g++."""
+    global LIB, _LOAD_FAILED
+    if LIB is not None:
+        return LIB
+    if _LOAD_FAILED:
+        return None
+    if os.environ.get("HVD_DISABLE_NATIVE", "") in ("1", "true"):
+        _LOAD_FAILED = True
+        return None
+    try:
+        if not os.path.exists(_LIB_PATH) and auto_build:
+            build()
+        LIB = _configure(ctypes.CDLL(_LIB_PATH))
+    except Exception:
+        LIB = None
+        _LOAD_FAILED = True
+    return LIB
+
+
+def available():
+    return load() is not None

@@ -1,0 +1,228 @@
+// Core services: logging, fusion planner, LRU plan cache, tensor table.
+// See hvd_core.h for the reference-design citations.
+
+#include "hvd_core.h"
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// logging
+// ---------------------------------------------------------------------------
+
+std::atomic<int> g_log_level{3};  // WARNING
+const char* kLevelNames[] = {"TRACE", "DEBUG", "INFO",
+                             "WARNING", "ERROR", "FATAL"};
+std::mutex g_log_mutex;
+
+}  // namespace
+
+void hvd_log_set_level(int level) {
+  g_log_level.store(std::max(0, std::min(5, level)));
+}
+
+int hvd_log_get_level() { return g_log_level.load(); }
+
+void hvd_log(int level, const char* msg) {
+  if (level < g_log_level.load()) return;
+  std::lock_guard<std::mutex> lock(g_log_mutex);
+  std::fprintf(stderr, "[%s hvd_core] %s\n",
+               kLevelNames[std::max(0, std::min(5, level))], msg);
+}
+
+// ---------------------------------------------------------------------------
+// fusion planner — greedy look-ahead bucketing in submission order, one
+// open bucket per dtype, oversized tensors alone (FuseResponses semantics).
+// ---------------------------------------------------------------------------
+
+int64_t hvd_plan_buckets(int64_t n, const int64_t* nbytes,
+                         const int32_t* dtype_ids, int64_t threshold,
+                         int32_t* bucket_out) {
+  if (n <= 0) return 0;
+  if (threshold <= 0) {
+    for (int64_t i = 0; i < n; ++i) bucket_out[i] = static_cast<int32_t>(i);
+    return n;
+  }
+  struct Open {
+    int32_t id;
+    int64_t bytes;
+  };
+  std::unordered_map<int32_t, Open> open;  // dtype -> open bucket
+  int32_t next_id = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = open.find(dtype_ids[i]);
+    if (it != open.end() && it->second.bytes + nbytes[i] <= threshold) {
+      bucket_out[i] = it->second.id;
+      it->second.bytes += nbytes[i];
+    } else {
+      bucket_out[i] = next_id;
+      open[dtype_ids[i]] = Open{next_id, nbytes[i]};
+      ++next_id;
+    }
+  }
+  return next_id;
+}
+
+// ---------------------------------------------------------------------------
+// LRU plan cache
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Cache {
+  explicit Cache(int64_t cap) : capacity(cap) {}
+  int64_t capacity;
+  std::mutex mutex;
+  std::list<std::pair<uint64_t, int64_t>> order;  // front = most recent
+  std::unordered_map<uint64_t,
+                     std::list<std::pair<uint64_t, int64_t>>::iterator>
+      index;
+  std::atomic<int64_t> hits{0};
+  std::atomic<int64_t> misses{0};
+};
+
+}  // namespace
+
+void* hvd_cache_create(int64_t capacity) { return new Cache(capacity); }
+
+void hvd_cache_destroy(void* cache) { delete static_cast<Cache*>(cache); }
+
+int64_t hvd_cache_lookup(void* cache, uint64_t key) {
+  auto* c = static_cast<Cache*>(cache);
+  std::lock_guard<std::mutex> lock(c->mutex);
+  auto it = c->index.find(key);
+  if (it == c->index.end()) {
+    c->misses++;
+    return -1;
+  }
+  c->order.splice(c->order.begin(), c->order, it->second);
+  c->hits++;
+  return it->second->second;
+}
+
+void hvd_cache_insert(void* cache, uint64_t key, int64_t value) {
+  auto* c = static_cast<Cache*>(cache);
+  if (c->capacity <= 0) return;
+  std::lock_guard<std::mutex> lock(c->mutex);
+  auto it = c->index.find(key);
+  if (it != c->index.end()) {
+    it->second->second = value;
+    c->order.splice(c->order.begin(), c->order, it->second);
+    return;
+  }
+  c->order.emplace_front(key, value);
+  c->index[key] = c->order.begin();
+  while (static_cast<int64_t>(c->order.size()) > c->capacity) {
+    c->index.erase(c->order.back().first);
+    c->order.pop_back();
+  }
+}
+
+int64_t hvd_cache_hits(void* cache) {
+  return static_cast<Cache*>(cache)->hits.load();
+}
+
+int64_t hvd_cache_misses(void* cache) {
+  return static_cast<Cache*>(cache)->misses.load();
+}
+
+int64_t hvd_cache_size(void* cache) {
+  auto* c = static_cast<Cache*>(cache);
+  std::lock_guard<std::mutex> lock(c->mutex);
+  return static_cast<int64_t>(c->order.size());
+}
+
+void hvd_cache_clear(void* cache) {
+  auto* c = static_cast<Cache*>(cache);
+  std::lock_guard<std::mutex> lock(c->mutex);
+  c->order.clear();
+  c->index.clear();
+}
+
+// ---------------------------------------------------------------------------
+// tensor table + stall detection
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Table {
+  std::mutex mutex;
+  struct Entry {
+    int64_t nbytes;
+    double enqueue_time;
+  };
+  std::unordered_map<std::string, Entry> entries;
+};
+
+}  // namespace
+
+void* hvd_table_create() { return new Table(); }
+
+void hvd_table_destroy(void* table) { delete static_cast<Table*>(table); }
+
+int hvd_table_add(void* table, const char* name, int64_t nbytes,
+                  double now_sec) {
+  auto* t = static_cast<Table*>(table);
+  std::lock_guard<std::mutex> lock(t->mutex);
+  auto result = t->entries.emplace(name, Table::Entry{nbytes, now_sec});
+  return result.second ? 0 : -1;
+}
+
+int hvd_table_remove(void* table, const char* name) {
+  auto* t = static_cast<Table*>(table);
+  std::lock_guard<std::mutex> lock(t->mutex);
+  return t->entries.erase(name) ? 0 : -1;
+}
+
+int64_t hvd_table_count(void* table) {
+  auto* t = static_cast<Table*>(table);
+  std::lock_guard<std::mutex> lock(t->mutex);
+  return static_cast<int64_t>(t->entries.size());
+}
+
+int64_t hvd_table_stalled(void* table, double now_sec, double warn_sec,
+                          char* buf, int64_t buflen) {
+  auto* t = static_cast<Table*>(table);
+  std::lock_guard<std::mutex> lock(t->mutex);
+  std::string joined;
+  int64_t count = 0;
+  for (const auto& kv : t->entries) {
+    if (now_sec - kv.second.enqueue_time > warn_sec) {
+      if (count > 0) joined += ",";
+      joined += kv.first;
+      ++count;
+    }
+  }
+  if (buf != nullptr && buflen > 0) {
+    std::strncpy(buf, joined.c_str(), buflen - 1);
+    buf[buflen - 1] = '\0';
+  }
+  return count;
+}
+
+// ---------------------------------------------------------------------------
+// misc
+// ---------------------------------------------------------------------------
+
+const char* hvd_core_version() { return "0.1.0"; }
+
+// FNV-1a 64-bit
+uint64_t hvd_hash_bytes(const void* data, int64_t len) {
+  const auto* p = static_cast<const unsigned char*>(data);
+  uint64_t h = 1469598103934665603ull;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}

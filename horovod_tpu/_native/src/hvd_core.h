@@ -1,0 +1,109 @@
+// horovod_tpu native runtime core.
+//
+// TPU-native re-implementation of the reference's C++ runtime services
+// (horovod/common/): the pieces that remain host-side work when the data
+// plane is XLA collectives instead of MPI/NCCL. Each component cites the
+// reference design it replaces:
+//
+//   logging        <- horovod/common/logging.{h,cc} (LogMessage, levels)
+//   fusion planner <- FuseResponses look-ahead bucketing
+//                     (horovod/common/operations.cc:450-573)
+//   plan cache     <- ResponseCache LRU + bypass fast path
+//                     (horovod/common/response_cache.{h,cc})
+//   tensor table   <- HorovodGlobalState::tensor_table + stall bookkeeping
+//                     (horovod/common/global_state.h:44-149,
+//                      CheckForStalledTensors operations.cc:688-769)
+//   timeline       <- horovod/common/timeline.{h,cc} (writer thread + queue)
+//   autotuner      <- ParameterManager + BayesianOptimization +
+//                     GaussianProcessRegressor
+//                     (horovod/common/parameter_manager.{h,cc},
+//                      horovod/common/optim/*)
+//
+// The API is a flat extern-C surface consumed from Python via ctypes
+// (the reference exposed extern-C the same way for horovod_init etc.,
+// operations.cc:1595-1650). All functions are thread-safe.
+
+#ifndef HVD_CORE_H_
+#define HVD_CORE_H_
+
+#include <cstdint>
+
+#if defined(_WIN32)
+#define HVD_EXPORT __declspec(dllexport)
+#else
+#define HVD_EXPORT __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+
+// ---- logging --------------------------------------------------------------
+// levels: 0=TRACE 1=DEBUG 2=INFO 3=WARNING 4=ERROR 5=FATAL
+HVD_EXPORT void hvd_log_set_level(int level);
+HVD_EXPORT int hvd_log_get_level();
+HVD_EXPORT void hvd_log(int level, const char* msg);
+
+// ---- fusion planner -------------------------------------------------------
+// Greedy look-ahead bucketing: same-dtype tensors packed in submission
+// order into buckets of <= threshold bytes; oversized tensors go alone.
+// Writes bucket id per tensor into bucket_out; returns the bucket count.
+HVD_EXPORT int64_t hvd_plan_buckets(int64_t n, const int64_t* nbytes,
+                                    const int32_t* dtype_ids,
+                                    int64_t threshold, int32_t* bucket_out);
+
+// ---- plan cache (LRU) -----------------------------------------------------
+HVD_EXPORT void* hvd_cache_create(int64_t capacity);
+HVD_EXPORT void hvd_cache_destroy(void* cache);
+HVD_EXPORT int64_t hvd_cache_lookup(void* cache, uint64_t key);  // -1 = miss
+HVD_EXPORT void hvd_cache_insert(void* cache, uint64_t key, int64_t value);
+HVD_EXPORT int64_t hvd_cache_hits(void* cache);
+HVD_EXPORT int64_t hvd_cache_misses(void* cache);
+HVD_EXPORT int64_t hvd_cache_size(void* cache);
+HVD_EXPORT void hvd_cache_clear(void* cache);
+
+// ---- tensor table + stall detection --------------------------------------
+HVD_EXPORT void* hvd_table_create();
+HVD_EXPORT void hvd_table_destroy(void* table);
+// returns 0 on success, -1 if the name is already outstanding (duplicate)
+HVD_EXPORT int hvd_table_add(void* table, const char* name, int64_t nbytes,
+                             double now_sec);
+HVD_EXPORT int hvd_table_remove(void* table, const char* name);
+HVD_EXPORT int64_t hvd_table_count(void* table);
+// Names outstanding longer than warn_sec, comma-joined into buf (truncated
+// to buflen); returns the number of stalled entries.
+HVD_EXPORT int64_t hvd_table_stalled(void* table, double now_sec,
+                                     double warn_sec, char* buf,
+                                     int64_t buflen);
+
+// ---- timeline -------------------------------------------------------------
+HVD_EXPORT void* hvd_timeline_create(const char* path, int mark_cycles);
+HVD_EXPORT void hvd_timeline_destroy(void* timeline);
+// phase: 0 = begin span, 1 = end span, 2 = instant event
+HVD_EXPORT void hvd_timeline_event(void* timeline, const char* tensor,
+                                   const char* activity, int phase);
+HVD_EXPORT void hvd_timeline_cycle(void* timeline);
+HVD_EXPORT int64_t hvd_timeline_pending(void* timeline);
+
+// ---- autotuner (Gaussian process + expected improvement) -----------------
+// Tunes (fusion_threshold_bytes, cycle_time_ms) to maximize a throughput
+// score (bytes/us like the reference). Bounds mirror the reference's
+// 0..64MB / 1..100ms (parameter_manager.cc:46-54).
+HVD_EXPORT void* hvd_autotune_create(double thr_lo, double thr_hi,
+                                     double ct_lo, double ct_hi,
+                                     uint64_t seed);
+HVD_EXPORT void hvd_autotune_destroy(void* tuner);
+HVD_EXPORT void hvd_autotune_record(void* tuner, double threshold,
+                                    double cycle_ms, double score);
+HVD_EXPORT void hvd_autotune_suggest(void* tuner, double* threshold_out,
+                                     double* cycle_ms_out);
+HVD_EXPORT int64_t hvd_autotune_num_samples(void* tuner);
+// Best observed (threshold, cycle_ms, score); returns 0 if no samples.
+HVD_EXPORT int hvd_autotune_best(void* tuner, double* threshold_out,
+                                 double* cycle_ms_out, double* score_out);
+
+// ---- misc -----------------------------------------------------------------
+HVD_EXPORT const char* hvd_core_version();
+HVD_EXPORT uint64_t hvd_hash_bytes(const void* data, int64_t len);
+
+}  // extern "C"
+
+#endif  // HVD_CORE_H_

@@ -62,6 +62,13 @@ BROADCAST = "broadcast"
 ALLTOALL = "alltoall"
 
 
+def _entry_nbytes(entry):
+    from .fusion import _nbytes
+    if entry.kind == "list":
+        return sum(_nbytes(t) for t in entry.tensor)
+    return _nbytes(entry.tensor)
+
+
 class TensorTableEntry:
     """Parity: TensorTableEntry (common.h:167-184)."""
 
@@ -182,6 +189,23 @@ class EagerCoordinator:
         self._stall_warned = set()
         self.timeline = timeline_mod.create_from_env(
             self._config, jax.process_index() == 0)
+        self.autotuner = None
+        if self._config.autotune:
+            if jax.process_count() > 1:
+                # Per-process tuning would diverge the fusion plans across
+                # processes — multi-controller SPMD needs identical
+                # collective order everywhere. Until tuned values flow
+                # through the coordination service, autotune is single-
+                # process only (the reference broadcasts tuned params from
+                # the coordinator for the same reason,
+                # parameter_manager.cc:66-81).
+                log.warning("HOROVOD_AUTOTUNE is single-process only for "
+                            "now; disabling on this %d-process run.",
+                            jax.process_count())
+            else:
+                from ..utils import autotune as autotune_mod
+                self.autotuner = autotune_mod.Autotuner(
+                    self._config, log_path=self._config.autotune_log or None)
         self._thread = threading.Thread(
             target=self._background_loop, daemon=True, name="hvd-background")
         self._thread.start()
@@ -268,12 +292,24 @@ class EagerCoordinator:
                 self.timeline.mark_cycle_start()
                 for e in batch:
                     self.timeline.negotiate_end(e.name)
-            key = tuple(e.signature() for e in batch)
+            t0 = time.perf_counter()
+            # the plan depends on the (possibly autotuned) fusion threshold
+            key = (int(self._config.fusion_threshold),
+                   tuple(e.signature() for e in batch))
             plan = self.plan_cache.get(key)
             if plan is None:
                 plan = self._make_plan(batch)
                 self.plan_cache.put(key, plan)
             self._execute(batch, plan)
+            if self.autotuner is not None:
+                total = sum(_entry_nbytes(e) for e in batch)
+                if self.autotuner.record_cycle(total,
+                                               time.perf_counter() - t0):
+                    # apply the next suggestion (ParameterManager::Tune)
+                    self._config.fusion_threshold = int(
+                        self.autotuner.threshold)
+                    self._config.cycle_time_ms = float(
+                        self.autotuner.cycle_time_ms)
 
     def _make_plan(self, batch):
         """Group fusable entries (stacked allreduces by dtype/average), one
@@ -524,3 +560,6 @@ class EagerCoordinator:
         if self.timeline:
             self.timeline.close()
             self.timeline = None
+        if self.autotuner is not None:
+            self.autotuner.close()
+            self.autotuner = None

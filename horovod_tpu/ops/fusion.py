@@ -41,28 +41,63 @@ def plan_buckets(leaves, fusion_threshold):
     Returns list of Bucket. Tensors larger than the threshold get their own
     bucket (the reference also sends oversized tensors unfused,
     operations.cc:466-476).
-    """
-    buckets = []
-    if fusion_threshold is None or fusion_threshold <= 0:
-        for i, leaf in enumerate(leaves):
-            buckets.append(Bucket([i], jnp.asarray(leaf).dtype
-                                  if not hasattr(leaf, "dtype") else leaf.dtype,
-                                  _nbytes(leaf)))
-        return buckets
 
-    open_buckets = {}  # dtype -> Bucket still accepting entries
-    for i, leaf in enumerate(leaves):
-        dt = leaf.dtype
-        nb = _nbytes(leaf)
-        b = open_buckets.get(dt)
-        if b is not None and b.nbytes + nb <= fusion_threshold:
-            b.indices.append(i)
-            b.nbytes += nb
+    The planning itself runs in the native core when built
+    (_native/src/hvd_core.cc:hvd_plan_buckets — identical algorithm); the
+    pure-Python path below is the fallback.
+    """
+    if fusion_threshold is None:
+        fusion_threshold = 0
+    sizes = [_nbytes(leaf) for leaf in leaves]
+    dtypes = [leaf.dtype for leaf in leaves]
+    assignment = _native_plan(sizes, dtypes, int(fusion_threshold))
+    if assignment is None:
+        assignment = _python_plan(sizes, dtypes, int(fusion_threshold))
+    buckets = {}
+    order = []
+    for i, bid in enumerate(assignment):
+        b = buckets.get(bid)
+        if b is None:
+            b = Bucket([], dtypes[i], 0)
+            buckets[bid] = b
+            order.append(b)
+        b.indices.append(i)
+        b.nbytes += sizes[i]
+    return order
+
+
+def _native_plan(sizes, dtypes, threshold):
+    from .. import _native
+    lib = _native.load()
+    if lib is None or not sizes:
+        return None if lib is None else []
+    import ctypes
+    n = len(sizes)
+    dtype_ids = {}
+    ids = [dtype_ids.setdefault(str(d), len(dtype_ids)) for d in dtypes]
+    c_sizes = (ctypes.c_int64 * n)(*sizes)
+    c_ids = (ctypes.c_int32 * n)(*ids)
+    out = (ctypes.c_int32 * n)()
+    lib.hvd_plan_buckets(n, c_sizes, c_ids, threshold, out)
+    return list(out)
+
+
+def _python_plan(sizes, dtypes, threshold):
+    if threshold <= 0:
+        return list(range(len(sizes)))
+    assignment = []
+    open_buckets = {}  # dtype -> (bucket id, bytes)
+    next_id = 0
+    for i, (nb, dt) in enumerate(zip(sizes, dtypes)):
+        cur = open_buckets.get(str(dt))
+        if cur is not None and cur[1] + nb <= threshold:
+            assignment.append(cur[0])
+            open_buckets[str(dt)] = (cur[0], cur[1] + nb)
         else:
-            b = Bucket([i], dt, nb)
-            buckets.append(b)
-            open_buckets[dt] = b
-    return buckets
+            assignment.append(next_id)
+            open_buckets[str(dt)] = (next_id, nb)
+            next_id += 1
+    return assignment
 
 
 def _nbytes(leaf):

@@ -1,0 +1,145 @@
+"""Online autotuning of fusion_threshold and cycle_time.
+
+Parity with the reference ParameterManager (horovod/common/
+parameter_manager.{h,cc}): Bayesian optimization (GP + expected improvement)
+over fusion-threshold in [0, 64MB] and cycle-time in [1, 100] ms
+(parameter_manager.cc:46-54), scoring bytes/us over windows of cycles
+(Update/Tune, parameter_manager.cc:155-210), with an optional CSV log
+(HOROVOD_AUTOTUNE_LOG, parameter_manager.cc:96-102). The GP/EI engine is the
+native core (_native/src/autotune.cc); a pure-Python random-search fallback
+keeps autotuning available without the toolchain.
+
+Where the reference's coordinator broadcasts tuned values over a custom MPI
+struct (parameter_manager.cc:66-81), the single-controller design needs no
+broadcast: every process tunes deterministically from identical
+measurements, or rank 0's values flow through broadcast_object.
+"""
+
+import ctypes
+import random
+import time
+
+from .. import _native
+
+THRESHOLD_BOUNDS = (0.0, 64.0 * 1024 * 1024)
+CYCLE_BOUNDS_MS = (1.0, 100.0)
+# samples per parameter point before scoring (reference: 5 samples of 10
+# cycles each, parameter_manager.h)
+CYCLES_PER_SAMPLE = 10
+SAMPLES_PER_STEP = 5
+
+
+class _NativeEngine:
+    def __init__(self, seed):
+        self._lib = _native.load()
+        self._ptr = self._lib.hvd_autotune_create(
+            THRESHOLD_BOUNDS[0], THRESHOLD_BOUNDS[1],
+            CYCLE_BOUNDS_MS[0], CYCLE_BOUNDS_MS[1], seed)
+
+    def record(self, threshold, cycle_ms, score):
+        self._lib.hvd_autotune_record(self._ptr, threshold, cycle_ms, score)
+
+    def suggest(self):
+        thr, ct = ctypes.c_double(), ctypes.c_double()
+        self._lib.hvd_autotune_suggest(self._ptr, ctypes.byref(thr),
+                                       ctypes.byref(ct))
+        return thr.value, ct.value
+
+    def best(self):
+        thr, ct, sc = (ctypes.c_double() for _ in range(3))
+        if self._lib.hvd_autotune_best(self._ptr, ctypes.byref(thr),
+                                       ctypes.byref(ct), ctypes.byref(sc)):
+            return thr.value, ct.value, sc.value
+        return None
+
+    def __del__(self):
+        try:
+            self._lib.hvd_autotune_destroy(self._ptr)
+        except Exception:
+            pass
+
+
+class _PythonEngine:
+    """Random-search fallback (no GP)."""
+
+    def __init__(self, seed):
+        self._rng = random.Random(seed)
+        self._samples = []
+
+    def record(self, threshold, cycle_ms, score):
+        self._samples.append((threshold, cycle_ms, score))
+
+    def suggest(self):
+        if len(self._samples) >= 4 and self._rng.random() < 0.5:
+            # exploit: jitter around the best point
+            thr, ct, _ = max(self._samples, key=lambda s: s[2])
+            thr += self._rng.gauss(0, (THRESHOLD_BOUNDS[1] -
+                                       THRESHOLD_BOUNDS[0]) * 0.1)
+            ct += self._rng.gauss(0, (CYCLE_BOUNDS_MS[1] -
+                                      CYCLE_BOUNDS_MS[0]) * 0.1)
+            thr = min(max(thr, THRESHOLD_BOUNDS[0]), THRESHOLD_BOUNDS[1])
+            ct = min(max(ct, CYCLE_BOUNDS_MS[0]), CYCLE_BOUNDS_MS[1])
+            return thr, ct
+        return (self._rng.uniform(*THRESHOLD_BOUNDS),
+                self._rng.uniform(*CYCLE_BOUNDS_MS))
+
+    def best(self):
+        if not self._samples:
+            return None
+        return max(self._samples, key=lambda s: s[2])
+
+
+class Autotuner:
+    """Drives the tune loop from per-cycle (bytes, duration) measurements.
+
+    Call ``record_cycle(total_bytes, duration_s)`` after each flush cycle;
+    the tuner aggregates CYCLES_PER_SAMPLE cycles into one sample,
+    SAMPLES_PER_STEP samples into one scored step (median-of-samples like
+    the reference), then records the score and moves the knobs to the next
+    suggestion. Current knob values are ``threshold`` / ``cycle_time_ms``.
+    """
+
+    def __init__(self, config, log_path=None, seed=0):
+        self.threshold = float(config.fusion_threshold)
+        self.cycle_time_ms = float(config.cycle_time_ms)
+        self._engine = (_NativeEngine(seed) if _native.available()
+                        else _PythonEngine(seed))
+        self._cycle_bytes = 0
+        self._cycle_time = 0.0
+        self._cycles = 0
+        self._scores = []
+        self._log = open(log_path, "w") if log_path else None
+        if self._log:
+            self._log.write("threshold_bytes,cycle_time_ms,score_bytes_per_us\n")
+
+    def record_cycle(self, total_bytes, duration_s):
+        self._cycle_bytes += int(total_bytes)
+        self._cycle_time += float(duration_s)
+        self._cycles += 1
+        if self._cycles < CYCLES_PER_SAMPLE:
+            return False
+        score = self._cycle_bytes / max(1e-9, self._cycle_time) / 1e6  # B/us
+        self._scores.append(score)
+        self._cycle_bytes = 0
+        self._cycle_time = 0.0
+        self._cycles = 0
+        if len(self._scores) < SAMPLES_PER_STEP:
+            return False
+        self._scores.sort()
+        median = self._scores[len(self._scores) // 2]
+        self._scores = []
+        self._engine.record(self.threshold, self.cycle_time_ms, median)
+        if self._log:
+            self._log.write(f"{self.threshold:.0f},{self.cycle_time_ms:.2f},"
+                            f"{median:.4f}\n")
+            self._log.flush()
+        self.threshold, self.cycle_time_ms = self._engine.suggest()
+        return True
+
+    def best(self):
+        return self._engine.best()
+
+    def close(self):
+        if self._log:
+            self._log.close()
+            self._log = None

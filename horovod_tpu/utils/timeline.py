@@ -124,9 +124,55 @@ class Timeline:
             pass
 
 
+class NativeTimeline:
+    """Same interface as Timeline, backed by the native writer thread
+    (_native/src/timeline.cc)."""
+
+    def __init__(self, filename, mark_cycles=False):
+        from .. import _native
+        self._lib = _native.load()
+        self._ptr = self._lib.hvd_timeline_create(
+            filename.encode(), 1 if mark_cycles else 0)
+        if not self._ptr:
+            raise OSError(f"cannot open timeline file {filename}")
+
+    @property
+    def enabled(self):
+        return self._ptr is not None
+
+    def start_activity(self, tensor_name, activity):
+        self._lib.hvd_timeline_event(self._ptr, tensor_name.encode(),
+                                     activity.encode(), 0)
+
+    def end_activity(self, tensor_name, activity=None):
+        self._lib.hvd_timeline_event(self._ptr, tensor_name.encode(), b"", 1)
+
+    def negotiate_start(self, tensor_name, op_name):
+        self.start_activity(tensor_name, f"NEGOTIATE_{op_name.upper()}")
+
+    def negotiate_end(self, tensor_name):
+        self.end_activity(tensor_name)
+
+    def mark_cycle_start(self):
+        self._lib.hvd_timeline_cycle(self._ptr)
+
+    def close(self):
+        if self._ptr:
+            self._lib.hvd_timeline_destroy(self._ptr)
+            self._ptr = None
+
+
 def create_from_env(config, is_coordinator):
-    """Rank-0-only creation (reference operations.cc:986-994)."""
-    if config.timeline_filename and is_coordinator:
-        return Timeline(config.timeline_filename,
-                        mark_cycles=config.timeline_mark_cycles)
-    return None
+    """Rank-0-only creation (reference operations.cc:986-994). Prefers the
+    native writer; falls back to the Python one."""
+    if not (config.timeline_filename and is_coordinator):
+        return None
+    from .. import _native
+    if _native.available():
+        try:
+            return NativeTimeline(config.timeline_filename,
+                                  mark_cycles=config.timeline_mark_cycles)
+        except OSError:
+            pass
+    return Timeline(config.timeline_filename,
+                    mark_cycles=config.timeline_mark_cycles)

@@ -1,0 +1,49 @@
+"""Build horovod_tpu and its native runtime core.
+
+The reference builds five framework-specific native extensions with a 1000-
+line setup.py of compiler/ABI probing (reference setup.py:32-520). The TPU
+build needs exactly one: libhvd_core.so (logging, fusion planner, plan
+cache, timeline writer, tensor table, GP/EI autotuner) with no third-party
+deps, so the build is g++ on three .cc files.
+
+    python setup.py build_native   # compile libhvd_core.so in-place
+    python setup.py develop/install
+"""
+
+import os
+
+from setuptools import Command, find_packages, setup
+
+
+class BuildNative(Command):
+    description = "compile the native runtime core (libhvd_core.so)"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        from horovod_tpu import _native
+        path = _native.build(force=True)
+        print(f"built {path}")
+
+
+setup(
+    name="horovod_tpu",
+    version="0.1.0",
+    description="TPU-native distributed deep learning framework "
+                "(Horovod-capability, JAX/XLA/Pallas architecture)",
+    packages=find_packages(exclude=("tests",)),
+    package_data={"horovod_tpu._native": ["libhvd_core.so", "src/*"]},
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "numpy"],
+    cmdclass={"build_native": BuildNative},
+    entry_points={
+        "console_scripts": [
+            "hvdrun = horovod_tpu.run.launcher:main",
+        ]
+    },
+)

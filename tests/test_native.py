@@ -1,0 +1,148 @@
+"""Native runtime core (libhvd_core.so) — C++ parity components
+(SURVEY.md §2.1: fusion planner, response cache, tensor table/stall,
+timeline writer, autotuner)."""
+
+import ctypes
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import _native
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="native core not built")
+
+
+def lib():
+    return _native.load()
+
+
+def test_version():
+    assert lib().hvd_core_version().decode() == "0.1.0"
+
+
+def test_plan_buckets_matches_python():
+    from horovod_tpu.ops import fusion
+    rng = np.random.RandomState(0)
+    sizes = [int(s) for s in rng.randint(1, 10000, 64)]
+    dtypes = [["float32", "bfloat16", "int32"][i % 3] for i in range(64)]
+    for threshold in (0, 1, 5000, 50000, 10**9):
+        native = fusion._native_plan(sizes, dtypes, threshold)
+        python = fusion._python_plan(sizes, dtypes, threshold)
+        assert native == python, threshold
+
+
+def test_cache_lru_eviction():
+    L = lib()
+    c = L.hvd_cache_create(3)
+    try:
+        for k in range(5):
+            L.hvd_cache_insert(c, k, k * 10)
+        assert L.hvd_cache_size(c) == 3
+        assert L.hvd_cache_lookup(c, 0) == -1  # evicted
+        assert L.hvd_cache_lookup(c, 4) == 40
+        # touching 2 makes 3 the LRU
+        L.hvd_cache_lookup(c, 2)
+        L.hvd_cache_insert(c, 99, 990)
+        assert L.hvd_cache_lookup(c, 3) == -1
+        assert L.hvd_cache_lookup(c, 2) == 20
+        assert L.hvd_cache_hits(c) >= 3
+    finally:
+        L.hvd_cache_destroy(c)
+
+
+def test_table_duplicate_and_stall():
+    L = lib()
+    t = L.hvd_table_create()
+    try:
+        assert L.hvd_table_add(t, b"grad/w", 1024, 10.0) == 0
+        assert L.hvd_table_add(t, b"grad/w", 1024, 10.0) == -1
+        assert L.hvd_table_add(t, b"grad/b", 8, 50.0) == 0
+        buf = ctypes.create_string_buffer(256)
+        n = L.hvd_table_stalled(t, 80.0, 60.0, buf, 256)
+        assert n == 1 and buf.value == b"grad/w"
+        assert L.hvd_table_remove(t, b"grad/w") == 0
+        assert L.hvd_table_count(t) == 1
+    finally:
+        L.hvd_table_destroy(t)
+
+
+def test_native_timeline_writes_chrome_trace(tmp_path):
+    from horovod_tpu.utils.timeline import NativeTimeline
+    path = str(tmp_path / "trace.json")
+    tl = NativeTimeline(path, mark_cycles=True)
+    tl.negotiate_start("tensor_a", "allreduce")
+    tl.negotiate_end("tensor_a")
+    tl.start_activity("tensor_a", "ALLREDUCE")
+    tl.end_activity("tensor_a")
+    tl.mark_cycle_start()
+    time.sleep(0.2)
+    tl.close()
+    data = open(path).read()
+    assert "NEGOTIATE_ALLREDUCE" in data
+    assert "ALLREDUCE" in data
+    assert "CYCLE_START" in data
+    assert "tensor_a" in data
+    # well-formed JSON array
+    events = json.loads(data)
+    assert isinstance(events, list) and len(events) >= 5
+
+
+def test_autotuner_converges_to_peak():
+    """GP/EI must find the score peak in a smooth 2-D landscape
+    (ParameterManager behavior)."""
+    L = lib()
+    t = L.hvd_autotune_create(0.0, 64e6, 1.0, 100.0, 123)
+    try:
+        thr, ct = ctypes.c_double(), ctypes.c_double()
+        for _ in range(30):
+            L.hvd_autotune_suggest(t, ctypes.byref(thr), ctypes.byref(ct))
+            score = math.exp(-((thr.value - 16e6) / 20e6) ** 2 -
+                             ((ct.value - 30) / 40) ** 2)
+            L.hvd_autotune_record(t, thr.value, ct.value, score)
+        sc = ctypes.c_double()
+        assert L.hvd_autotune_best(t, ctypes.byref(thr), ctypes.byref(ct),
+                                   ctypes.byref(sc))
+        assert sc.value > 0.9  # near the peak (max is 1.0)
+    finally:
+        L.hvd_autotune_destroy(t)
+
+
+def test_hash_stable():
+    L = lib()
+    h1 = L.hvd_hash_bytes(b"hello", 5)
+    h2 = L.hvd_hash_bytes(b"hello", 5)
+    h3 = L.hvd_hash_bytes(b"hellp", 5)
+    assert h1 == h2 != h3
+
+
+def test_autotuner_integration_with_coordinator(hvd):
+    """HOROVOD_AUTOTUNE=1: the coordinator feeds cycle measurements and the
+    knobs move off their defaults after enough cycles."""
+    import horovod_tpu
+    from horovod_tpu.common.config import HorovodConfig
+
+    hvd.shutdown()
+    cfg = HorovodConfig.from_env()
+    cfg.autotune = True
+    cfg.cycle_time_ms = 1.0
+    hvd.init(config=cfg)
+    try:
+        coord = horovod_tpu.common.state.global_state().coordinator
+        assert coord.autotuner is not None
+        x = np.ones((8, 64), np.float32)
+        # 10 cycles/sample x 5 samples/step = 50 flushes per tuning step
+        for i in range(120):
+            coord._paused = True
+            h = hvd.allreduce_async(x, average=False, name=f"at{i}")
+            coord._paused = False
+            coord.flush()
+            hvd.synchronize(h)
+        assert coord.autotuner.best() is not None
+    finally:
+        hvd.shutdown()
+        hvd.init()
