@@ -111,8 +111,10 @@ def load(auto_build=True):
         _LOAD_FAILED = True
         return None
     try:
-        if not os.path.exists(_LIB_PATH) and auto_build:
-            build()
+        if auto_build:
+            build()  # no-op when the .so is newer than every source
+        elif not os.path.exists(_LIB_PATH):
+            raise FileNotFoundError(_LIB_PATH)
         LIB = _configure(ctypes.CDLL(_LIB_PATH))
     except Exception:
         LIB = None

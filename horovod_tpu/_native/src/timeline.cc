@@ -37,11 +37,41 @@ struct Timeline {
 
   ~Timeline() {
     if (healthy) {
-      Push(Event{"", "", 3, 0});
+      {
+        // The shutdown sentinel must never be dropped, or join() hangs —
+        // bypass the bounded Push and enqueue it unconditionally.
+        std::lock_guard<std::mutex> lock(mutex);
+        queue.push_back(Event{"", "", 3, 0});
+      }
+      cv.notify_one();
       writer.join();
       std::fputs("{}]\n", file);
       std::fclose(file);
     }
+  }
+
+  // Escape a string for embedding inside a JSON string literal.
+  static std::string JsonEscape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    return out;
   }
 
   int64_t NowUs() const {
@@ -70,7 +100,7 @@ struct Timeline {
     std::fprintf(file,
                  "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
                  "\"args\":{\"name\":\"%s\"}},\n",
-                 pid, tensor.c_str());
+                 pid, JsonEscape(tensor).c_str());
     return pid;
   }
 
@@ -90,7 +120,7 @@ struct Timeline {
           std::fprintf(file,
                        "{\"name\":\"%s\",\"ph\":\"B\",\"pid\":%d,"
                        "\"ts\":%lld},\n",
-                       e.activity.c_str(), pid,
+                       JsonEscape(e.activity).c_str(), pid,
                        static_cast<long long>(e.ts_us));
           break;
         case 1:
@@ -101,7 +131,7 @@ struct Timeline {
           std::fprintf(file,
                        "{\"name\":\"%s\",\"ph\":\"i\",\"pid\":%d,\"s\":\"g\","
                        "\"ts\":%lld},\n",
-                       e.activity.c_str(), pid,
+                       JsonEscape(e.activity).c_str(), pid,
                        static_cast<long long>(e.ts_us));
       }
       std::fflush(file);

@@ -58,6 +58,16 @@ def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
     """
     if state_mod.is_initialized():
         return
+    # hvdrun exports the rendezvous through env (run/cli.py:_rank_env), the
+    # way mpirun exports OMPI_COMM_WORLD_* for the reference
+    # (test/common.py:25-57). Explicit args win over env.
+    import os
+    if coordinator_address is None and "HVD_COORDINATOR_ADDR" in os.environ:
+        coordinator_address = os.environ["HVD_COORDINATOR_ADDR"]
+        num_processes = (num_processes if num_processes is not None
+                         else int(os.environ.get("HVD_NUM_PROC", "1")))
+        process_id = (process_id if process_id is not None
+                      else int(os.environ.get("HVD_PROCESS_ID", "0")))
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

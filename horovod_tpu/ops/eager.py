@@ -302,6 +302,16 @@ class EagerCoordinator:
                 self.plan_cache.put(key, plan)
             self._execute(batch, plan)
             if self.autotuner is not None:
+                # JAX dispatch is async: without blocking, t1-t0 measures
+                # host dispatch, not collective throughput, and the GP would
+                # tune noise. Only the tuning path pays this sync.
+                for e in batch:
+                    result = getattr(e, "result", None)
+                    if result is not None:
+                        try:
+                            jax.block_until_ready(result)
+                        except Exception:
+                            pass
                 total = sum(_entry_nbytes(e) for e in batch)
                 if self.autotuner.record_cycle(total,
                                                time.perf_counter() - t0):

@@ -1,0 +1,14 @@
+"""Launch layer: ``hvdrun`` CLI, driver/task services, host utilities.
+
+TPU-native replacement for the reference launch stack (reference
+horovod/run/run.py, bin/horovodrun, horovod/run/common/*). Where the
+reference discovers NICs and then execs ``mpirun`` (run/run.py:458-481),
+``hvdrun`` discovers a routable coordinator address the same way (ssh
+checks, task-service ring probing) and then spawns worker processes
+directly — each with ``HVD_COORDINATOR_ADDR`` / ``HVD_PROCESS_ID`` env so
+``hvd.init()`` can rendezvous through ``jax.distributed`` instead of MPI.
+"""
+
+from .secret import make_secret_key  # noqa: F401
+from .settings import Settings, Timeout  # noqa: F401
+from .hosts import HostSlots, parse_hosts  # noqa: F401

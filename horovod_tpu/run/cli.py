@@ -1,0 +1,238 @@
+"""``hvdrun`` — the launch CLI (reference bin/horovodrun → run/run.py).
+
+Where the reference discovers routable NICs and then execs ``mpirun`` with
+interface flags and ``env -x`` forwarding (run/run.py:458-481), hvdrun uses
+the same discovery machinery to choose a coordinator address and then
+spawns every worker process itself — locally via subprocess, remotely via
+ssh — with the rendezvous exported through environment variables:
+
+    HVD_COORDINATOR_ADDR  host:port of the jax.distributed coordinator
+    HVD_NUM_PROC          total worker count (== -np)
+    HVD_PROCESS_ID        this worker's global rank
+    HVD_LOCAL_RANK/SIZE   rank/size within the host
+    HVD_CROSS_RANK/SIZE   host index / host count (GLOBAL/LOCAL/CROSS
+                          communicator parity, reference mpi_context.h:40-49)
+
+``hvd.init()`` reads these to call jax.distributed.initialize, the TPU
+analogue of MPI_Init inside the background thread (operations.cc:869-888).
+"""
+
+import argparse
+import base64
+import os
+import socket
+import sys
+import time
+
+from . import cache as cache_mod
+from . import exec_util, hosts, secret, services, task_fn
+from .settings import Settings, Timeout
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu training job.",
+        usage="hvdrun -np N [-H hosts] command...")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="Total number of worker processes.")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="Comma-separated host:slots list "
+                        "(default: localhost:np).")
+    p.add_argument("-p", "--ssh-port", type=int, default=None,
+                   help="SSH port for remote hosts.")
+    p.add_argument("--start-timeout", type=int,
+                   default=int(os.environ.get("HOROVOD_START_TIMEOUT", 600)),
+                   help="Seconds to wait for all workers to start.")
+    p.add_argument("--disable-cache", action="store_true",
+                   help="Do not reuse cached ssh/interface check results.")
+    p.add_argument("--verbose", "-v", action="count", default=0)
+    p.add_argument("--output-dir", default=None,
+                   help="Redirect each rank's stdout/stderr to "
+                        "<dir>/rank.<i>.{out,err}.")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Training command, e.g. python train.py")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _discover_coordinator_ip(host_list, settings):
+    """Find an IP every host can route to (reference run/run.py:188-257).
+
+    Starts the driver service, ssh-launches one probe task per remote
+    host, waits for ring-probe results, intersects interfaces, and returns
+    the launcher's address on one common interface.
+    """
+    driver = services.LaunchDriverService(len(host_list), settings.key)
+    procs = []
+    try:
+        addrs_b64 = task_fn.codec_dumps(driver.addresses())
+        key_b64 = base64.b64encode(settings.key).decode("ascii")
+        for i, h in enumerate(host_list):
+            cmd = [sys.executable, "-m", "horovod_tpu.run.task_fn",
+                   str(i), str(len(host_list)), addrs_b64]
+            if hosts.is_local(h.hostname):
+                env = exec_util.filtered_env(
+                    {secret.HVD_SECRET_KEY: key_b64})
+                procs.append(exec_util.safe_execute(cmd, env=env))
+            else:
+                ssh = ["ssh"] + hosts.SSH_OPTS
+                if settings.ssh_port:
+                    ssh += ["-p", str(settings.ssh_port)]
+                remote = ["env", f"{secret.HVD_SECRET_KEY}={key_b64}"] + \
+                    exec_util.forwarded_env_flags() + cmd
+                procs.append(exec_util.safe_execute(
+                    ssh + [h.hostname] + remote))
+        timeout = Timeout(settings.start_timeout_s,
+                          "Timed out waiting for launch probe tasks. "
+                          "Check ssh connectivity and firewalls.")
+        driver.wait_for_initial_registration(timeout)
+        driver.wait_for_task_to_task_addresses(timeout)
+        common = driver.common_interfaces()
+        if settings.verbose:
+            print(f"hvdrun: common interfaces: {sorted(common)}")
+        # Tell probes to exit.
+        for i in range(len(host_list)):
+            try:
+                services.LaunchTaskClient(
+                    i, driver.task_addresses(i), settings.key).shutdown_task()
+            except Exception:
+                pass
+        # The launcher's own ip on a common interface is the coordinator.
+        from .network import local_addresses
+        mine = local_addresses()
+        for iface in sorted(common):
+            if iface in mine:
+                return mine[iface][0][0]
+        raise RuntimeError(
+            f"Launcher has no address on common interfaces {common}")
+    finally:
+        for proc in procs:
+            exec_util.terminate_tree(proc, grace_s=1.0)
+        driver.shutdown()
+
+
+def _rank_env(rank, local_rank, host_index, h, n_proc, n_hosts,
+              coordinator_addr):
+    return {
+        "HVD_COORDINATOR_ADDR": coordinator_addr,
+        "HVD_NUM_PROC": n_proc,
+        "HVD_PROCESS_ID": rank,
+        "HVD_LOCAL_RANK": local_rank,
+        "HVD_LOCAL_SIZE": h.slots,
+        "HVD_CROSS_RANK": host_index,
+        "HVD_CROSS_SIZE": n_hosts,
+    }
+
+
+def run_command_on_hosts(host_list, command, coordinator_addr, settings,
+                         output_dir=None):
+    """Spawn every worker, wait, propagate first failure. Returns exit
+    code."""
+    n_proc = sum(h.slots for h in host_list)
+    procs = []
+    files = []
+    rank = 0
+    for host_index, h in enumerate(host_list):
+        for local_rank in range(h.slots):
+            env_over = _rank_env(rank, local_rank, host_index, h, n_proc,
+                                 len(host_list), coordinator_addr)
+            stdout = stderr = None
+            if output_dir:
+                os.makedirs(output_dir, exist_ok=True)
+                stdout = open(os.path.join(output_dir,
+                                           f"rank.{rank}.out"), "wb")
+                stderr = open(os.path.join(output_dir,
+                                           f"rank.{rank}.err"), "wb")
+                files += [stdout, stderr]
+            if hosts.is_local(h.hostname):
+                env = exec_util.filtered_env(env_over)
+                procs.append(exec_util.safe_execute(
+                    command, env=env, stdout=stdout, stderr=stderr))
+            else:
+                ssh = ["ssh"] + hosts.SSH_OPTS
+                if settings.ssh_port:
+                    ssh += ["-p", str(settings.ssh_port)]
+                remote = ["env"] + \
+                    [f"{k}={v}" for k, v in env_over.items()] + \
+                    exec_util.forwarded_env_flags() + list(command)
+                procs.append(exec_util.safe_execute(
+                    ssh + [h.hostname] + remote,
+                    stdout=stdout, stderr=stderr))
+            rank += 1
+
+    exit_code = 0
+    try:
+        pending = set(range(len(procs)))
+        while pending:
+            for i in sorted(pending):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                pending.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    # One failed worker aborts the job, as an MPI abort
+                    # would (reference semantics of mpirun).
+                    for j in sorted(pending):
+                        exec_util.terminate_tree(procs[j])
+                    pending.clear()
+                    break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for proc in procs:
+            exec_util.terminate_tree(proc)
+        exit_code = 130
+    finally:
+        for f in files:
+            f.close()
+    return exit_code
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    host_list = (hosts.parse_hosts(args.hosts) if args.hosts
+                 else [hosts.HostSlots("localhost", args.num_proc)])
+    n_slots = sum(h.slots for h in host_list)
+    if n_slots < args.num_proc:
+        sys.exit(f"hvdrun: -np {args.num_proc} but only {n_slots} slots in "
+                 f"host list")
+
+    key_env = os.environ.get("HOROVOD_SECRET_KEY") or \
+        os.environ.get("HVD_SECRET_KEY")
+    settings = Settings(
+        num_proc=args.num_proc, hosts=host_list, command=args.command,
+        key=(base64.b64decode(key_env) if key_env
+             else secret.make_secret_key()),
+        start_timeout_s=args.start_timeout, ssh_port=args.ssh_port,
+        verbose=args.verbose)
+
+    remote = [h.hostname for h in host_list
+              if not hosts.is_local(h.hostname)]
+    if remote:
+        fn_cache = None if args.disable_cache else cache_mod.Cache()
+        hosts.check_all_hosts_ssh_successful(remote, fn_cache=fn_cache)
+        coordinator_ip = _discover_coordinator_ip(host_list, settings)
+    else:
+        coordinator_ip = "127.0.0.1"
+
+    coordinator_addr = f"{coordinator_ip}:{_free_port()}"
+    if args.verbose:
+        print(f"hvdrun: launching {args.num_proc} processes on "
+              f"{len(host_list)} host(s); coordinator {coordinator_addr}")
+    sys.exit(run_command_on_hosts(host_list, args.command, coordinator_addr,
+                                  settings, output_dir=args.output_dir))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Process execution with group cleanup + env filtering.
+
+Reference: horovod/run/common/util/safe_shell_exec.py (process-group kill
+on parent death) and horovod/run/common/util/env.py (which env vars are
+forwarded to workers).
+"""
+
+import os
+import re
+import signal
+import subprocess
+import threading
+
+# Env vars never forwarded to workers (reference env.py IGNORE_REGEX).
+_IGNORE = re.compile(r"^(BASH_FUNC|OLDPWD$|PWD$|SHLVL$|_$|LS_COLORS$)")
+# Vars always forwarded when present.
+_FORWARD_PREFIXES = ("HOROVOD_", "HVD_", "JAX_", "XLA_", "TPU_", "LIBTPU_",
+                     "PYTHON", "PATH", "LD_LIBRARY_PATH", "NCCL_")
+
+
+def is_exportable(name):
+    return not _IGNORE.match(name)
+
+
+def filtered_env(extra=None):
+    """Environment to hand to spawned workers."""
+    env = {k: v for k, v in os.environ.items() if is_exportable(k)}
+    if extra:
+        env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def forwarded_env_flags(env=None):
+    """The subset of env worth forwarding over ssh, as VAR=VAL strings."""
+    env = env if env is not None else os.environ
+    out = []
+    for k, v in env.items():
+        if any(k.startswith(p) for p in _FORWARD_PREFIXES) and \
+                is_exportable(k):
+            out.append(f"{k}={v}")
+    return out
+
+
+def safe_execute(command, env=None, stdout=None, stderr=None,
+                 on_exit=None, index=None):
+    """Run command in its own process group; returns the Popen. A watcher
+    thread reaps it and optionally calls on_exit(index, returncode)
+    (reference safe_shell_exec.py:17-144 semantics, simplified: no orphan
+    monitor process — workers are killed via killpg on terminate())."""
+    proc = subprocess.Popen(command, env=env, stdout=stdout, stderr=stderr,
+                            start_new_session=True)
+
+    if on_exit is not None:
+        def watch():
+            rc = proc.wait()
+            on_exit(index, rc)
+        threading.Thread(target=watch, daemon=True).start()
+    return proc
+
+
+def terminate_tree(proc, grace_s=5.0):
+    """SIGTERM then SIGKILL the whole process group."""
+    if proc.poll() is not None:
+        return
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        proc.wait(timeout=grace_s)
+    except Exception:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except Exception:
+            pass

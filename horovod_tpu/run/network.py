@@ -1,0 +1,216 @@
+"""HMAC-authenticated pickle-over-TCP RPC for the launch services.
+
+Same wire contract as the reference (horovod/run/common/util/network.py:
+49-84): every message is ``digest(32) | length(4) | body`` where body is a
+cloudpickled object and the digest is HMAC-SHA256 under a per-job secret
+key. Services bind an ephemeral port and serve on a daemon thread; clients
+try every (ip, port) pair they were given and remember the first route that
+answers a Ping.
+"""
+
+import queue
+import random
+import socket
+import socketserver
+import struct
+import threading
+
+import cloudpickle
+import psutil
+
+from . import secret
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    def __init__(self, service_name, source_address):
+        self.service_name = service_name
+        self.source_address = source_address  # client ip as seen by service
+
+
+class AckResponse:
+    pass
+
+
+class NoValidAddressesFound(Exception):
+    pass
+
+
+class Wire:
+    """Serialize/authenticate one message per direction on a stream."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def write(self, obj, wfile):
+        body = cloudpickle.dumps(obj)
+        wfile.write(secret.compute_digest(self._key, body))
+        wfile.write(struct.pack("i", len(body)))
+        wfile.write(body)
+        wfile.flush()
+
+    def read(self, rfile):
+        digest = rfile.read(secret.DIGEST_LENGTH)
+        (length,) = struct.unpack("i", rfile.read(4))
+        body = rfile.read(length)
+        if not secret.check_digest(self._key, body, digest):
+            raise RuntimeError(
+                "Security error: HMAC digest did not match the message.")
+        return cloudpickle.loads(body)
+
+
+def local_addresses(port=None):
+    """All non-loopback IPv4 addresses of this host, as (ip, port) pairs
+    keyed by interface name (reference network.py get_local_host_addresses)."""
+    result = {}
+    for iface, addrs in psutil.net_if_addrs().items():
+        for addr in addrs:
+            if addr.family == socket.AF_INET and addr.address != "127.0.0.1":
+                result.setdefault(iface, []).append((addr.address, port))
+    return result
+
+
+class BasicService:
+    """Threaded TCP server speaking Wire; subclasses override _handle."""
+
+    def __init__(self, service_name, key):
+        self._service_name = service_name
+        self._wire = Wire(key)
+        self._server = self._bind_ephemeral()
+        self._port = self._server.socket.getsockname()[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _bind_ephemeral(self):
+        # Randomized start offset avoids collisions when many services bind
+        # at once on the same host (reference network.py:97-108).
+        lo, hi = 1024, 65536
+        start = random.randrange(hi - lo)
+        for off in range(hi - lo):
+            try:
+                port = lo + (start + off) % (hi - lo)
+                srv = socketserver.ThreadingTCPServer(
+                    ("0.0.0.0", port), self._make_handler())
+                srv.daemon_threads = True
+                return srv
+            except OSError:
+                continue
+        raise RuntimeError("Unable to find a port to bind to.")
+
+    def _make_handler(self):
+        service = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    req = service._wire.read(self.rfile)
+                    resp = service._handle(req, self.client_address)
+                    if resp is None:
+                        raise RuntimeError("Handler returned no response.")
+                    service._wire.write(resp, self.wfile)
+                except (EOFError, ConnectionError):
+                    pass
+
+        return Handler
+
+    def _handle(self, req, client_address):
+        if isinstance(req, PingRequest):
+            return PingResponse(self._service_name, client_address[0])
+        raise NotImplementedError(req)
+
+    def addresses(self):
+        return {iface: [(ip, self._port) for ip, _ in addrs]
+                for iface, addrs in local_addresses().items()}
+
+    @property
+    def port(self):
+        return self._port
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class BasicClient:
+    """Client that resolves the first reachable (ip, port) of a service.
+
+    addresses: {iface: [(ip, port), ...]} as published by the service
+    (possibly via the driver). Probing happens in parallel threads with the
+    given per-attempt timeout (reference network.py _probe/_connect).
+    """
+
+    def __init__(self, service_name, addresses, key, probe_timeout=5.0,
+                 attempts=3):
+        self._service_name = service_name
+        self._wire = Wire(key)
+        self._timeout = probe_timeout
+        self._addr = None
+        for _ in range(attempts):
+            self._addr = self._probe(addresses)
+            if self._addr:
+                break
+        if self._addr is None:
+            raise NoValidAddressesFound(
+                f"Unable to connect to {service_name} at any of {addresses}")
+
+    def _probe(self, addresses):
+        results = queue.Queue()
+        threads = []
+        for addrs in addresses.values():
+            for addr in addrs:
+                t = threading.Thread(target=self._try_ping,
+                                     args=(addr, results), daemon=True)
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join()
+        try:
+            return results.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _try_ping(self, addr, results):
+        try:
+            resp = self._request_at(PingRequest(), addr)
+            if isinstance(resp, PingResponse) and \
+                    resp.service_name == self._service_name:
+                results.put(addr)
+        except Exception:
+            pass
+
+    def _request_at(self, req, addr):
+        with socket.create_connection(addr, timeout=self._timeout) as sock:
+            rfile = sock.makefile("rb")
+            wfile = sock.makefile("wb")
+            self._wire.write(req, wfile)
+            return self._wire.read(rfile)
+
+    def request(self, req):
+        return self._request_at(req, self._addr)
+
+    @property
+    def address(self):
+        return self._addr
+
+
+def probe_reachable(service_name, addresses, key, timeout=5.0):
+    """Which of {iface: [(ip, port)]} answer a valid Ping for service_name —
+    the NIC ring-probe primitive (reference run/run.py:234-255)."""
+    wire = Wire(key)
+    reachable = {}
+    for iface, addrs in addresses.items():
+        for addr in addrs:
+            try:
+                with socket.create_connection(addr, timeout=timeout) as sock:
+                    wire.write(PingRequest(), sock.makefile("wb"))
+                    resp = wire.read(sock.makefile("rb"))
+            except Exception:
+                continue
+            if isinstance(resp, PingResponse) and \
+                    resp.service_name == service_name:
+                reachable.setdefault(iface, []).append(addr)
+    return reachable

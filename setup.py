@@ -43,7 +43,7 @@ setup(
     cmdclass={"build_native": BuildNative},
     entry_points={
         "console_scripts": [
-            "hvdrun = horovod_tpu.run.launcher:main",
+            "hvdrun = horovod_tpu.run.cli:main",
         ]
     },
 )
