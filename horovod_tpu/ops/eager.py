@@ -404,6 +404,15 @@ class EagerCoordinator:
         spec = P(self._axis, *([None] * (arr.ndim - 1)))
         return jax.device_put(arr, self._sharding(spec))
 
+    @functools.cached_property
+    def _replicate(self):
+        """Reshard a worker-sharded result to fully replicated. Horovod's
+        contract is that every worker holds the complete reduced tensor
+        after the op; on >1 process a sharded result would not even be
+        readable by the caller (non-addressable shards). XLA lowers this to
+        the all-gather leg a ring allreduce ends with anyway."""
+        return jax.jit(lambda x: x, out_shardings=self._sharding(P()))
+
     def _exec_fused_stacked_allreduce(self, entries, average):
         """Fuse [world, n_i] tensors into one [world, total] buffer, one
         psum, split back (MPIAllreduce memcpy-in/allreduce/memcpy-out,
@@ -421,7 +430,7 @@ class EagerCoordinator:
             for n in names:
                 tl.end_activity(n)
                 tl.start_activity(n, timeline_mod.ALLREDUCE)
-        summed = self._stacked_psum(fused)
+        summed = self._replicate(self._stacked_psum(fused))
         if average:
             summed = summed / self._world
         if tl:
@@ -460,7 +469,7 @@ class EagerCoordinator:
         if kind == "stacked":
             x = self._put_stacked(
                 jnp.reshape(jnp.asarray(entry.tensor), (self._world, -1)))
-            out = self._stacked_psum(x)
+            out = self._replicate(self._stacked_psum(x))
             if entry.average:
                 out = out / self._world
             return jnp.reshape(out, np.shape(entry.tensor))
@@ -494,7 +503,7 @@ class EagerCoordinator:
     def _broadcast_one(self, entry, kind):
         if kind == "stacked":
             x = self._put_stacked(jnp.asarray(entry.tensor))
-            return self._stacked_bcast(x, int(entry.root_rank))
+            return self._replicate(self._stacked_bcast(x, int(entry.root_rank)))
         if jax.process_count() == 1:
             return jnp.asarray(entry.tensor)
         from jax.experimental import multihost_utils

@@ -90,7 +90,8 @@ def _discover_coordinator_ip(host_list, settings):
                 if settings.ssh_port:
                     ssh += ["-p", str(settings.ssh_port)]
                 remote = ["env", f"{secret.HVD_SECRET_KEY}={key_b64}"] + \
-                    exec_util.forwarded_env_flags() + cmd
+                    exec_util.forwarded_env_flags(quote=True) + \
+                    exec_util.quote_argv(cmd)
                 procs.append(exec_util.safe_execute(
                     ssh + [h.hostname] + remote))
         timeout = Timeout(settings.start_timeout_s,
@@ -108,14 +109,18 @@ def _discover_coordinator_ip(host_list, settings):
                     i, driver.task_addresses(i), settings.key).shutdown_task()
             except Exception:
                 pass
-        # The launcher's own ip on a common interface is the coordinator.
-        from .network import local_addresses
-        mine = local_addresses()
+        # jax.distributed has process 0 BIND the coordinator socket, so the
+        # address must belong to the host that runs rank 0 (host_list[0]),
+        # not the launcher — horovodrun may be invoked from a machine
+        # outside the host list. Task 0's registration gives us its IP on
+        # a commonly-routable interface.
+        rank0_addrs = driver.task_addresses(0)
         for iface in sorted(common):
-            if iface in mine:
-                return mine[iface][0][0]
+            if iface in rank0_addrs:
+                return rank0_addrs[iface][0][0]
         raise RuntimeError(
-            f"Launcher has no address on common interfaces {common}")
+            f"Rank-0 host {host_list[0].hostname} has no address on common "
+            f"interfaces {common}")
     finally:
         for proc in procs:
             exec_util.terminate_tree(proc, grace_s=1.0)
@@ -136,45 +141,54 @@ def _rank_env(rank, local_rank, host_index, h, n_proc, n_hosts,
 
 
 def run_command_on_hosts(host_list, command, coordinator_addr, settings,
-                         output_dir=None):
+                         output_dir=None, extra_env=None, cancel_event=None):
     """Spawn every worker, wait, propagate first failure. Returns exit
-    code."""
+    code. Setting cancel_event terminates all workers (exit 130)."""
     n_proc = sum(h.slots for h in host_list)
     procs = []
     files = []
-    rank = 0
-    for host_index, h in enumerate(host_list):
-        for local_rank in range(h.slots):
-            env_over = _rank_env(rank, local_rank, host_index, h, n_proc,
-                                 len(host_list), coordinator_addr)
-            stdout = stderr = None
-            if output_dir:
-                os.makedirs(output_dir, exist_ok=True)
-                stdout = open(os.path.join(output_dir,
-                                           f"rank.{rank}.out"), "wb")
-                stderr = open(os.path.join(output_dir,
-                                           f"rank.{rank}.err"), "wb")
-                files += [stdout, stderr]
-            if hosts.is_local(h.hostname):
-                env = exec_util.filtered_env(env_over)
-                procs.append(exec_util.safe_execute(
-                    command, env=env, stdout=stdout, stderr=stderr))
-            else:
-                ssh = ["ssh"] + hosts.SSH_OPTS
-                if settings.ssh_port:
-                    ssh += ["-p", str(settings.ssh_port)]
-                remote = ["env"] + \
-                    [f"{k}={v}" for k, v in env_over.items()] + \
-                    exec_util.forwarded_env_flags() + list(command)
-                procs.append(exec_util.safe_execute(
-                    ssh + [h.hostname] + remote,
-                    stdout=stdout, stderr=stderr))
-            rank += 1
-
     exit_code = 0
     try:
+        rank = 0
+        for host_index, h in enumerate(host_list):
+            for local_rank in range(h.slots):
+                env_over = _rank_env(rank, local_rank, host_index, h, n_proc,
+                                     len(host_list), coordinator_addr)
+                if extra_env:
+                    env_over.update(extra_env)
+                stdout = stderr = None
+                if output_dir:
+                    os.makedirs(output_dir, exist_ok=True)
+                    stdout = open(os.path.join(output_dir,
+                                               f"rank.{rank}.out"), "wb")
+                    stderr = open(os.path.join(output_dir,
+                                               f"rank.{rank}.err"), "wb")
+                    files += [stdout, stderr]
+                if hosts.is_local(h.hostname):
+                    env = exec_util.filtered_env(env_over)
+                    procs.append(exec_util.safe_execute(
+                        command, env=env, stdout=stdout, stderr=stderr))
+                else:
+                    ssh = ["ssh"] + hosts.SSH_OPTS
+                    if settings.ssh_port:
+                        ssh += ["-p", str(settings.ssh_port)]
+                    remote = ["env"] + \
+                        exec_util.quote_argv(
+                            f"{k}={v}" for k, v in env_over.items()) + \
+                        exec_util.forwarded_env_flags(quote=True) + \
+                        exec_util.quote_argv(command)
+                    procs.append(exec_util.safe_execute(
+                        ssh + [h.hostname] + remote,
+                        stdout=stdout, stderr=stderr))
+                rank += 1
+
         pending = set(range(len(procs)))
         while pending:
+            if cancel_event is not None and cancel_event.is_set():
+                for j in sorted(pending):
+                    exec_util.terminate_tree(procs[j])
+                exit_code = exit_code or 130
+                break
             for i in sorted(pending):
                 rc = procs[i].poll()
                 if rc is None:
@@ -189,10 +203,15 @@ def run_command_on_hosts(host_list, command, coordinator_addr, settings,
                     pending.clear()
                     break
             time.sleep(0.2)
-    except KeyboardInterrupt:
+    except BaseException:
+        # Spawn failure mid-loop or Ctrl-C: never leak already-started
+        # workers waiting on a coordinator that will not form.
         for proc in procs:
             exec_util.terminate_tree(proc)
-        exit_code = 130
+        if isinstance(sys.exc_info()[1], KeyboardInterrupt):
+            exit_code = 130
+        else:
+            raise
     finally:
         for f in files:
             f.close()
@@ -226,7 +245,14 @@ def main(argv=None):
     else:
         coordinator_ip = "127.0.0.1"
 
-    coordinator_addr = f"{coordinator_ip}:{_free_port()}"
+    # The coordinator socket is bound by rank 0 (on host_list[0]); probing
+    # a free port is only meaningful when that host is this machine.
+    if hosts.is_local(host_list[0].hostname):
+        coordinator_port = _free_port()
+    else:
+        import random
+        coordinator_port = random.randrange(30000, 60000)
+    coordinator_addr = f"{coordinator_ip}:{coordinator_port}"
     if args.verbose:
         print(f"hvdrun: launching {args.num_proc} processes on "
               f"{len(host_list)} host(s); coordinator {coordinator_addr}")

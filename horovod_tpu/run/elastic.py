@@ -1,0 +1,229 @@
+"""Elastic resource supervisor (reference submitjob.py, the CS744 fork's
+contribution).
+
+The reference daemon listens on a TCP port; a peer sends an integer N
+(``echo N | nc node0 5000``) to surrender N slots. The daemon shrinks the
+host list, shrinks further until the ORIGINAL total divides the new total
+(so the per-step global batch can be preserved exactly), kills the running
+``horovodrun``, and restarts it with ``--batches-per-allreduce =
+old_total/new_total`` and a load-from-checkpoint flag
+(submitjob.py:120-204). This is restart-based elasticity: recovery
+correctness comes from the checkpoint + ``broadcast_parameters`` on
+startup, not from in-flight migration.
+
+This supervisor keeps those semantics with hvdrun as the job runner.
+Command placeholders: ``{np}`` worker count, ``{hosts}`` host:slots list,
+``{bpa}`` batches-per-allreduce, ``{restart}`` restart ordinal (lets the
+training script decide to ``--loadcp``).
+"""
+
+import socket
+import subprocess
+import threading
+import time
+
+from . import exec_util
+from .hosts import HostSlots, parse_hosts
+
+DEFAULT_PORTS = (5000, 5001, 5002)
+
+
+def shrink_hosts(host_list, remove_n, starting_total):
+    """Pure rebalance: drop remove_n slots (from the last host backward),
+    then keep dropping until starting_total % new_total == 0
+    (submitjob.py updateResources/removeAdditionalResources).
+
+    Returns (new_host_list, new_total) or raises if no valid allocation
+    remains.
+    """
+    slots = [h.slots for h in host_list]
+    to_remove = remove_n
+    while to_remove > 0 and any(slots):
+        for i in range(len(slots) - 1, -1, -1):
+            if slots[i] > 0:
+                slots[i] -= 1
+                to_remove -= 1
+                break
+    new_total = sum(slots)
+    while new_total > 0 and starting_total % new_total != 0:
+        for i in range(len(slots) - 1, -1, -1):
+            if slots[i] > 0:
+                slots[i] -= 1
+                new_total -= 1
+                break
+    if new_total <= 0:
+        raise ValueError(
+            f"Removing {remove_n} slots leaves no valid allocation "
+            f"(starting total {starting_total}).")
+    new_hosts = [HostSlots(h.hostname, s)
+                 for h, s in zip(host_list, slots) if s > 0]
+    return new_hosts, new_total
+
+
+class ElasticSupervisor:
+    """Run a job command elastically, restarting with fewer slots on
+    demand."""
+
+    def __init__(self, hosts, command, ports=DEFAULT_PORTS, verbose=1,
+                 runner=None):
+        self.hosts = parse_hosts(hosts) if isinstance(hosts, str) else hosts
+        self.command = list(command)
+        self.starting_total = sum(h.slots for h in self.hosts)
+        self.current_total = self.starting_total
+        self.ports = ports
+        self.verbose = verbose
+        self.restarts = 0
+        self._exit_code = 0
+        self._proc = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener = None
+        self._sock = None
+        self._runner = runner or self._default_runner
+        self.port = None
+
+    # -- job control -------------------------------------------------------
+
+    def _format_command(self):
+        hosts_str = ",".join(f"{h.hostname}:{h.slots}" for h in self.hosts)
+        subs = {"np": self.current_total, "hosts": hosts_str,
+                "bpa": self.starting_total // self.current_total,
+                "restart": self.restarts}
+        return [c.format(**subs) for c in self.command]
+
+    def _default_runner(self, argv):
+        return exec_util.safe_execute(argv)
+
+    def _start_job(self):
+        argv = self._format_command()
+        if self.verbose:
+            print(f"elastic: starting job (restart #{self.restarts}, "
+                  f"np={self.current_total}, "
+                  f"bpa={self.starting_total // self.current_total}): "
+                  f"{argv}")
+        self._proc = self._runner(argv)
+
+    def _kill_job(self):
+        if self._proc is not None:
+            exec_util.terminate_tree(self._proc)
+            self._proc = None
+
+    # -- listener ----------------------------------------------------------
+
+    def _bind(self):
+        for port in self.ports:
+            try:
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("", port))
+                s.listen(5)
+                s.settimeout(0.5)
+                self.port = port
+                return s
+            except OSError:
+                continue
+        raise RuntimeError(f"elastic: unable to bind any of {self.ports}")
+
+    def _listen_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = int(conn.recv(1024))
+            except (ValueError, OSError):
+                conn.close()
+                continue
+            try:
+                self.remove_slots(msg, source=addr[0])
+            except ValueError as e:
+                # Bad allocation: kill the job rather than leave it running
+                # unsupervised, and report failure (submitjob exits here
+                # too, but leaks its horovodrun).
+                print(f"elastic: ERROR: {e}")
+                self._exit_code = 1
+                self.shutdown()
+            conn.close()
+
+    # -- public API --------------------------------------------------------
+
+    def remove_slots(self, n, source="local"):
+        """Shrink by n slots and restart the job (submitjob listener)."""
+        with self._lock:
+            new_hosts, new_total = shrink_hosts(self.hosts, n,
+                                                self.starting_total)
+            if self.verbose:
+                print(f"elastic: request from {source}: slots "
+                      f"{self.current_total}->{new_total}; "
+                      f"batches-per-allreduce -> "
+                      f"{self.starting_total // new_total}")
+            self.hosts, self.current_total = new_hosts, new_total
+            self._kill_job()
+            self.restarts += 1
+            self._start_job()
+
+    def start(self):
+        self._sock = self._bind()
+        self._listener = threading.Thread(target=self._listen_loop,
+                                          daemon=True)
+        self._listener.start()
+        with self._lock:
+            self._start_job()
+        return self
+
+    def wait(self, poll_s=0.5):
+        """Block until the job exits on its own (not via a restart kill).
+        Returns its exit code."""
+        while not self._stop.is_set():
+            with self._lock:
+                proc = self._proc
+            if proc is None:
+                time.sleep(poll_s)
+                continue
+            try:
+                rc = proc.wait(timeout=poll_s)
+            except subprocess.TimeoutExpired:
+                continue
+            with self._lock:
+                if proc is self._proc:  # exited, not replaced by a restart
+                    self.shutdown()
+                    return rc
+        return self._exit_code
+
+    def shutdown(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._kill_job()
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.run.elastic",
+        description="Elastic job supervisor (submitjob.py parity). The "
+                    "command may use {np} {hosts} {bpa} {restart} "
+                    "placeholders.")
+    p.add_argument("-H", "--hosts", required=True)
+    p.add_argument("--ports", default=",".join(map(str, DEFAULT_PORTS)))
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    command = args.command[1:] if args.command[:1] == ["--"] else args.command
+    if not command:
+        p.error("no command given")
+    sup = ElasticSupervisor(
+        args.hosts, command,
+        ports=tuple(int(x) for x in args.ports.split(","))).start()
+    print(f"elastic: listening on port {sup.port}; send an integer to "
+          f"surrender that many slots (echo 2 | nc <host> {sup.port})")
+    raise SystemExit(sup.wait())
+
+
+if __name__ == "__main__":
+    main()

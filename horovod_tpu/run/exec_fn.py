@@ -1,0 +1,7 @@
+"""Worker entry for the programmatic launcher (reference
+horovod/spark/driver/mpirun_exec_fn.py)."""
+
+from .launch import worker_main
+
+if __name__ == "__main__":
+    worker_main()

@@ -7,6 +7,7 @@ forwarded to workers).
 
 import os
 import re
+import shlex
 import signal
 import subprocess
 import threading
@@ -30,15 +31,23 @@ def filtered_env(extra=None):
     return env
 
 
-def forwarded_env_flags(env=None):
-    """The subset of env worth forwarding over ssh, as VAR=VAL strings."""
+def forwarded_env_flags(env=None, quote=False):
+    """The subset of env worth forwarding over ssh, as VAR=VAL strings.
+    quote=True shell-quotes each entry — required whenever the list is
+    joined into an ssh command line, where the remote shell word-splits
+    (multi-flag XLA_FLAGS would otherwise shatter)."""
     env = env if env is not None else os.environ
     out = []
     for k, v in env.items():
         if any(k.startswith(p) for p in _FORWARD_PREFIXES) and \
                 is_exportable(k):
-            out.append(f"{k}={v}")
+            out.append(shlex.quote(f"{k}={v}") if quote else f"{k}={v}")
     return out
+
+
+def quote_argv(argv):
+    """Shell-quote every token for transport through `ssh host <cmd>`."""
+    return [shlex.quote(str(a)) for a in argv]
 
 
 def safe_execute(command, env=None, stdout=None, stderr=None,

@@ -1,0 +1,179 @@
+"""Programmatic launcher: ``horovod_tpu.run.launch.run(fn, ...)``.
+
+Parity with ``horovod.spark.run(fn)`` (reference spark/__init__.py:93-222)
+without the Spark dependency: the function is shipped to every worker via
+cloudpickle over the HMAC-authenticated service (the reference ships it
+through Spark's closure serialization + its own driver service), each rank
+executes ``fn(*args)``, and the per-rank results are collected back on the
+launcher in rank order (reference spark/__init__.py:217-222).
+"""
+
+import base64
+import threading
+import sys
+import traceback
+
+from . import hosts as hosts_mod
+from . import secret
+from .cli import _free_port, run_command_on_hosts
+from .network import AckResponse, BasicClient, BasicService
+from .settings import Settings, Timeout
+
+_SERVICE_ADDRS_ENV = "_HVD_RUN_SERVICE_ADDRS"
+
+
+class GetFunctionRequest:
+    pass
+
+
+class GetFunctionResponse:
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ResultRequest:
+    def __init__(self, rank, ok, payload):
+        self.rank = rank
+        self.ok = ok
+        self.payload = payload  # result if ok else formatted traceback
+
+
+class RunFnService(BasicService):
+    NAME = "hvdrun fn service"
+
+    def __init__(self, fn, args, kwargs, num_proc, key):
+        super().__init__(self.NAME, key)
+        self._fn, self._args, self._kwargs = fn, args, kwargs
+        self._num_proc = num_proc
+        self._results = {}
+        self._lock = threading.Lock()
+        self._all_done = threading.Event()
+
+    def _handle(self, req, client_address):
+        if isinstance(req, GetFunctionRequest):
+            return GetFunctionResponse(self._fn, self._args, self._kwargs)
+        if isinstance(req, ResultRequest):
+            with self._lock:
+                self._results[req.rank] = (req.ok, req.payload)
+                if len(self._results) == self._num_proc:
+                    self._all_done.set()
+            return AckResponse()
+        return super()._handle(req, client_address)
+
+    def wait_for_results(self, timeout: Timeout):
+        while not self._all_done.wait(1.0):
+            timeout.check()
+        return self.partial_results()
+
+    def partial_results(self):
+        with self._lock:
+            return dict(self._results)
+
+
+class RunFnClient(BasicClient):
+    def __init__(self, addresses, key):
+        super().__init__(RunFnService.NAME, addresses, key)
+
+    def fetch_function(self):
+        resp = self.request(GetFunctionRequest())
+        return resp.fn, resp.args, resp.kwargs
+
+    def report(self, rank, ok, payload):
+        self.request(ResultRequest(rank, ok, payload))
+
+
+def run(fn, args=(), kwargs=None, num_proc=1, hosts=None, env=None,
+        start_timeout_s=600.0, verbose=0):
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` workers; return the list
+    of per-rank return values, rank order (spark/__init__.py:93-222).
+
+    Workers get the standard HVD_* rendezvous env, so ``hvd.init()`` inside
+    fn forms the distributed runtime exactly as under ``hvdrun``.
+    """
+    kwargs = kwargs or {}
+    host_list = (hosts_mod.parse_hosts(hosts) if hosts
+                 else [hosts_mod.HostSlots("localhost", num_proc)])
+    n_slots = sum(h.slots for h in host_list)
+    if n_slots != num_proc:
+        # One worker per slot is spawned; a mismatch either hangs the
+        # result wait (too few) or tears workers down mid-run (too many).
+        raise ValueError(
+            f"num_proc={num_proc} but the host list provides {n_slots} "
+            f"slots; they must match.")
+    key = secret.make_secret_key()
+    service = RunFnService(fn, args, kwargs, num_proc, key)
+    try:
+        from .task_fn import codec_dumps
+        extra_env = {
+            _SERVICE_ADDRS_ENV: codec_dumps(service.addresses()),
+            secret.HVD_SECRET_KEY:
+                base64.b64encode(key).decode("ascii"),
+        }
+        if env:
+            extra_env.update(env)
+        coordinator_addr = f"127.0.0.1:{_free_port()}"
+        settings = Settings(num_proc=num_proc, hosts=host_list,
+                            start_timeout_s=start_timeout_s,
+                            verbose=verbose)
+        command = [sys.executable, "-m", "horovod_tpu.run.exec_fn"]
+        rc_holder = {}
+        cancel = threading.Event()
+
+        def _launch():
+            rc_holder["rc"] = run_command_on_hosts(
+                host_list, command, coordinator_addr, settings,
+                extra_env=extra_env, cancel_event=cancel)
+
+        t = threading.Thread(target=_launch, daemon=True)
+        t.start()
+        timeout = Timeout(start_timeout_s,
+                          "Timed out waiting for worker results.")
+        try:
+            # Fail fast if a worker dies before it can report (segfault,
+            # OOM-kill): run_command_on_hosts returns its exit code long
+            # before the result timeout would fire.
+            died_rc = None
+            while not service._all_done.wait(0.5):
+                timeout.check()
+                if not t.is_alive():
+                    died_rc = rc_holder.get("rc")
+                    break
+            results = service.partial_results()
+        finally:
+            cancel.set()  # no-op if workers already exited
+            t.join(timeout=30.0)
+        failures = {r: p for r, (ok, p) in results.items() if not ok}
+        if failures:
+            rank, tb = sorted(failures.items())[0]
+            raise RuntimeError(
+                f"Worker rank {rank} raised:\n{tb}")
+        if died_rc:
+            raise RuntimeError(
+                f"A worker process exited with code {died_rc} before "
+                f"reporting a result.")
+        if len(results) < num_proc:
+            raise RuntimeError(
+                f"Only {len(results)}/{num_proc} workers reported results.")
+        return [results[r][1] for r in range(num_proc)]
+    finally:
+        service.shutdown()
+
+
+def worker_main():
+    """Entry for ``python -m horovod_tpu.run.exec_fn``."""
+    import os
+
+    from .task_fn import codec_loads
+    key = base64.b64decode(os.environ[secret.HVD_SECRET_KEY])
+    addresses = codec_loads(os.environ[_SERVICE_ADDRS_ENV])
+    rank = int(os.environ.get("HVD_PROCESS_ID", "0"))
+    client = RunFnClient(addresses, key)
+    fn, args, kwargs = client.fetch_function()
+    try:
+        result = fn(*args, **kwargs)
+    except BaseException:
+        client.report(rank, False, traceback.format_exc())
+        sys.exit(1)
+    client.report(rank, True, result)

@@ -7,6 +7,7 @@ then idle until the driver says shutdown.
 
 import base64
 import sys
+import time
 
 import cloudpickle
 
@@ -34,6 +35,8 @@ def main(index, num_tasks, driver_addresses_b64, key):
         next_addresses = {}
         while not next_addresses:
             next_addresses = driver.all_task_addresses(next_index)
+            if not next_addresses:
+                time.sleep(0.5)  # don't hammer the driver while peers start
         reachable = network.probe_reachable(
             services.LaunchTaskService.name_for(next_index),
             next_addresses, key)

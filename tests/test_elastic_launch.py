@@ -1,0 +1,112 @@
+"""Programmatic launcher + elastic supervisor tests (reference
+test_spark.py:51-110 for run(fn); submitjob.py semantics for elasticity)."""
+
+import socket
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.run.elastic import ElasticSupervisor, shrink_hosts
+from horovod_tpu.run.hosts import HostSlots, parse_hosts
+from horovod_tpu.run.launch import run
+
+
+class TestProgrammaticRun:
+    """run(fn) happy path / args / failure (test_spark.py test_happy_run
+    parity). Functions are defined as closures so cloudpickle ships them by
+    value, as Spark closures are shipped in the reference."""
+
+    def test_happy_run(self):
+        def fn():
+            import os
+            return (int(os.environ["HVD_PROCESS_ID"]),
+                    int(os.environ["HVD_NUM_PROC"]))
+
+        assert run(fn, num_proc=2) == [(0, 2), (1, 2)]
+
+    def test_args_kwargs(self):
+        def fn(a, b, scale=1):
+            import os
+            return (a + b) * scale + int(os.environ["HVD_PROCESS_ID"])
+
+        assert run(fn, args=(10, 5), kwargs={"scale": 2},
+                   num_proc=2) == [30, 31]
+
+    def test_worker_exception_propagates(self):
+        def fn():
+            import os
+            if os.environ["HVD_PROCESS_ID"] == "1":
+                raise ValueError("rank 1 exploded")
+            return "ok"
+
+        with pytest.raises(RuntimeError, match="rank 1 exploded"):
+            run(fn, num_proc=2)
+
+    def test_timeout(self):
+        with pytest.raises(Exception, match="[Tt]imed out"):
+            run(time.sleep, args=(60,), num_proc=1, start_timeout_s=2.0)
+
+
+class TestShrinkHosts:
+    def test_simple_removal(self):
+        hosts = parse_hosts("a:4,b:4")
+        new, total = shrink_hosts(hosts, 4, 8)
+        assert total == 4 and new == [HostSlots("a", 4)]
+
+    def test_divisibility_forces_extra_removal(self):
+        # 8 slots, remove 3 -> 5, but 8 % 5 != 0 -> shrink to 4 (bpa 2)
+        hosts = parse_hosts("a:4,b:4")
+        new, total = shrink_hosts(hosts, 3, 8)
+        assert total == 4
+        assert sum(h.slots for h in new) == 4
+
+    def test_removal_from_last_host_first(self):
+        hosts = parse_hosts("a:2,b:2")
+        new, total = shrink_hosts(hosts, 2, 4)
+        assert new == [HostSlots("a", 2)]
+
+    def test_impossible_raises(self):
+        with pytest.raises(ValueError):
+            shrink_hosts(parse_hosts("a:2"), 2, 2)
+
+
+class TestElasticSupervisor:
+    def test_restart_on_slot_removal(self, tmp_path):
+        """E2E: job logs {np},{bpa}; removing slots restarts it with the
+        rescaled values (submitjob.py:163-204)."""
+        log = tmp_path / "runs.log"
+        script = tmp_path / "job.py"
+        script.write_text(
+            "import sys, time\n"
+            "open(sys.argv[1], 'a').write(sys.argv[2] + '\\n')\n"
+            "time.sleep(60)\n")
+        sup = ElasticSupervisor(
+            "localhost:4",
+            [sys.executable, str(script), str(log), "np={np},bpa={bpa}"],
+            ports=tuple(range(15100, 15110)))
+        sup.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not log.exists():
+                time.sleep(0.1)
+            assert log.read_text() == "np=4,bpa=1\n"
+
+            # surrender 2 slots over TCP, as `echo 2 | nc` would
+            with socket.create_connection(("127.0.0.1", sup.port)) as s:
+                s.sendall(b"2")
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    log.read_text().count("\n") < 2:
+                time.sleep(0.1)
+            assert log.read_text() == "np=4,bpa=1\nnp=2,bpa=2\n"
+            assert sup.restarts == 1
+        finally:
+            sup.shutdown()
+
+    def test_wait_returns_job_exit_code(self):
+        sup = ElasticSupervisor(
+            "localhost:2", [sys.executable, "-c", "import sys; sys.exit(3)"],
+            ports=tuple(range(15110, 15120)), verbose=0)
+        sup.start()
+        assert sup.wait(poll_s=0.1) == 3
