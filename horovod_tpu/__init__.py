@@ -33,6 +33,9 @@ from .mpi_ops import (  # noqa: F401
     reducescatter, alltoall,
     poll, synchronize)
 from .ops.compression import Compression  # noqa: F401
+from .ops.sparse import (  # noqa: F401
+    IndexedSlices, sparse_allreduce)
+from . import callbacks  # noqa: F401
 from .optim import (  # noqa: F401
     DistributedOptimizer, allreduce_gradients, broadcast_object,
     broadcast_optimizer_state, broadcast_parameters, distributed_grad)

@@ -1,0 +1,306 @@
+"""Training-loop callbacks: broadcast-on-start, metric averaging, LR
+schedule/warmup with momentum correction.
+
+Parity targets (reference horovod/_keras/callbacks.py):
+  * ``BroadcastGlobalVariablesCallback``  — _keras/callbacks.py:20-30
+  * ``MetricAverageCallback``             — _keras/callbacks.py:33-67
+  * ``LearningRateScheduleCallback``      — _keras/callbacks.py:70-146
+    (staircase / continuous multipliers, momentum correction)
+  * ``LearningRateWarmupCallback``        — _keras/callbacks.py:149-168
+    (gradual warmup from lr/size to lr over N epochs, arXiv:1706.02677)
+
+TPU-native design: Keras callbacks mutate tf.Variables through a session;
+here the mutable surface is the ``hyperparams`` dict of an
+``optax.inject_hyperparams`` optimizer state, which the next jitted step
+reads as a traced input — no recompilation when the LR changes. Callbacks
+hold a ``LoopState`` (params/opt_state/logs) and update it in place, giving
+the Keras ergonomics over functional JAX internals. For fully-compiled
+training loops, ``warmup_schedule`` provides the same warmup curve as an
+``optax`` schedule instead.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import mpi_ops, optim
+
+
+# ---------------------------------------------------------------------------
+# Loop state + hyperparam plumbing
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LoopState:
+    """The mutable training-loop record callbacks operate on (the analogue
+    of the Keras model/optimizer the reference callbacks mutate)."""
+    params: Any = None
+    opt_state: Any = None
+    epoch: int = 0
+    steps_per_epoch: Optional[int] = None
+    logs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _iter_hyperparam_nodes(opt_state):
+    """Yield every node in an optimizer-state pytree carrying a mutable
+    ``hyperparams`` dict (optax.inject_hyperparams states, found at any
+    nesting depth — e.g. under optax.chain or MultiSteps)."""
+    stack = [opt_state]
+    while stack:
+        node = stack.pop()
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict):
+            yield node
+        if isinstance(node, (list, tuple)):  # incl. NamedTuple states
+            stack.extend(node)
+        elif isinstance(node, dict):
+            stack.extend(node.values())
+        elif dataclasses.is_dataclass(node):
+            stack.extend(getattr(node, f.name)
+                         for f in dataclasses.fields(node))
+
+
+def get_hyperparam(opt_state, name):
+    """Read a hyperparameter (e.g. 'learning_rate', 'momentum') from an
+    inject_hyperparams-wrapped optimizer state; None if absent."""
+    for node in _iter_hyperparam_nodes(opt_state):
+        if name in node.hyperparams:
+            return float(np.asarray(node.hyperparams[name]))
+    return None
+
+
+def set_hyperparam(opt_state, name, value):
+    """Set a hyperparameter in place (the dict inside the state is mutable
+    even though the surrounding pytree is not). Returns True if found."""
+    import jax.numpy as jnp
+    found = False
+    for node in _iter_hyperparam_nodes(opt_state):
+        if name in node.hyperparams:
+            prev = node.hyperparams[name]
+            node.hyperparams[name] = jnp.asarray(value).astype(
+                getattr(prev, "dtype", jnp.float32))
+            found = True
+    return found
+
+
+# ---------------------------------------------------------------------------
+# Callback protocol
+# ---------------------------------------------------------------------------
+
+class Callback:
+    """Base callback; hook names follow the Keras protocol the reference
+    implements against (_keras/callbacks.py)."""
+
+    loop: LoopState = None
+
+    def set_loop(self, loop: LoopState):
+        self.loop = loop
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    """Drives a list of callbacks against one LoopState."""
+
+    def __init__(self, callbacks: List[Callback], loop: LoopState):
+        self.callbacks = list(callbacks)
+        self.loop = loop
+        for cb in self.callbacks:
+            cb.set_loop(loop)
+
+    def __getattr__(self, hook):
+        if not hook.startswith("on_"):
+            raise AttributeError(hook)
+
+        def call(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, hook)(*args, **kwargs)
+        return call
+
+
+# ---------------------------------------------------------------------------
+# The four reference callbacks
+# ---------------------------------------------------------------------------
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast params + optimizer state from root_rank at train start so
+    all workers begin identically (reference _keras/callbacks.py:20-30,
+    BroadcastGlobalVariablesHook tensorflow/__init__.py:107-138)."""
+
+    def __init__(self, root_rank=0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        self.loop.params = optim.broadcast_parameters(
+            self.loop.params, root_rank=self.root_rank)
+        if self.loop.opt_state is not None:
+            self.loop.opt_state = optim.broadcast_optimizer_state(
+                self.loop.opt_state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all workers at epoch end, in sorted-name
+    order so every worker issues the same collectives (reference
+    _keras/callbacks.py:33-67)."""
+
+    def _average_metrics_in_place(self, logs):
+        logs = logs if logs is not None else {}
+        for metric in sorted(logs):
+            value = np.asarray(logs[metric], dtype=np.float32)
+            reduced = mpi_ops.allreduce(value, average=True,
+                                        name=f"metric.{metric}")
+            logs[metric] = float(np.asarray(reduced))
+        return logs
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average_metrics_in_place(
+            logs if logs is not None else self.loop.logs)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the initial LR by ``multiplier(epoch)`` — staircase (first
+    batch of each epoch) or continuous (every batch, with fractional epoch)
+    — with momentum correction m *= new_lr/old_lr during the adjusted batch
+    (reference _keras/callbacks.py:70-146; correction per arXiv:1706.02677).
+
+    Requires the optimizer to be built with ``optax.inject_hyperparams`` so
+    'learning_rate' (and 'momentum', if corrected) are state-visible.
+    """
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.current_epoch = None
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _adjust_learning_rate(self, epoch):
+        old_lr = get_hyperparam(self.loop.opt_state, "learning_rate")
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        if not set_hyperparam(self.loop.opt_state, "learning_rate", new_lr):
+            raise ValueError(
+                "LearningRateScheduleCallback needs an optimizer built with "
+                "optax.inject_hyperparams exposing 'learning_rate'.")
+        momentum = get_hyperparam(self.loop.opt_state, "momentum")
+        if momentum is not None and self.momentum_correction and old_lr:
+            self.restore_momentum = momentum
+            set_hyperparam(self.loop.opt_state, "momentum",
+                           momentum * new_lr / old_lr)
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum:
+            set_hyperparam(self.loop.opt_state, "momentum",
+                           self.restore_momentum)
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = get_hyperparam(self.loop.opt_state,
+                                         "learning_rate")
+        if self.initial_lr is None:
+            raise ValueError(
+                "LearningRateScheduleCallback needs an optimizer built with "
+                "optax.inject_hyperparams exposing 'learning_rate'.")
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self.loop.steps_per_epoch
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "Could not autodetect steps_per_epoch; pass it to "
+                    f"{type(self).__name__}() or set it on the LoopState.")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = get_hyperparam(self.loop.opt_state, "learning_rate")
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradually scale LR from lr (≈ lr_full/size at epoch 0) up to the full
+    size-scaled LR over ``warmup_epochs`` (reference
+    _keras/callbacks.py:149-168; "Accurate, Large Minibatch SGD").
+    """
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        def multiplier(epoch):
+            epoch += 1.0 / self.steps_per_epoch
+            size = mpi_ops.size()
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            new_lr = get_hyperparam(self.loop.opt_state, "learning_rate")
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {new_lr:g}.")
+
+
+# ---------------------------------------------------------------------------
+# Compiled-path equivalent
+# ---------------------------------------------------------------------------
+
+def warmup_schedule(base_lr, warmup_epochs, steps_per_epoch, size=None,
+                    after: Optional[Callable[[int], float]] = None):
+    """The warmup curve as an ``optax`` schedule (step → lr), for fully
+    jitted training loops where the callback path would force host sync.
+
+    Matches LearningRateWarmupCallback: lr(e) = base_lr/size *
+    (e*(size-1)/warmup_epochs + 1) for e < warmup_epochs, then ``after(step)``
+    (default: constant base_lr). ``base_lr`` is the full size-scaled LR.
+    """
+    import jax.numpy as jnp
+
+    def schedule(step):
+        n = size if size is not None else mpi_ops.size()
+        epoch = (step + 1.0) / steps_per_epoch
+        warm = base_lr / n * (epoch * (n - 1) / warmup_epochs + 1)
+        post = after(step) if after is not None else base_lr
+        return jnp.where(epoch < warmup_epochs, warm, post)
+    return schedule

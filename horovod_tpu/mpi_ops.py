@@ -115,12 +115,31 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
     horovod/tensorflow/__init__.py:36-83, horovod/torch/mpi_ops.py:85-108).
 
     In traced code this is a ``lax.psum`` over the mesh axis; eagerly it is
-    queued, fused, and executed by the coordination core.
+    queued, fused, and executed by the coordination core. An
+    ``IndexedSlices`` input takes the sparse allgather path (reference
+    tensorflow/__init__.py:62-73).
     """
+    from .ops import sparse as sparse_mod
+    if sparse_mod.is_indexed_slices(tensor):
+        if op not in (None, cops.SUM, cops.AVERAGE):
+            raise ValueError(
+                f"Sparse allreduce supports only sum/average, got op={op!r}")
+        if op is not None:
+            average = op == cops.AVERAGE
+        return sparse_mod.sparse_allreduce(tensor, average=average,
+                                           axis_name=axis_name, name=name,
+                                           compression=compression)
     if cops.in_traced_context(axis_name):
         return cops.allreduce_traced(tensor, average=average,
                                      axis_name=axis_name, op=op,
                                      compression=compression)
+    # Eager branch must honor op the same way the traced branch does.
+    if op not in (None, cops.SUM, cops.AVERAGE):
+        raise NotImplementedError(
+            f"Eager allreduce supports only sum/average, got op={op!r}; "
+            "min/max are available inside shard_map-traced code.")
+    if op is not None:
+        average = op == cops.AVERAGE
     handle = allreduce_async(tensor, average=average, name=name,
                              compression=compression)
     return synchronize(handle)
@@ -146,7 +165,17 @@ allreduce_async_ = allreduce_async
 
 def grouped_allreduce(tensors, average=True, compression=Compression.none,
                       axis_name=None, fusion_threshold=None):
-    """Fused allreduce of many tensors at once (explicit tensor fusion)."""
+    """Fused allreduce of many tensors at once (explicit tensor fusion).
+    ``IndexedSlices`` leaves take the sparse allgather path; their integer
+    indices must never enter the dense sum."""
+    from .ops import sparse as sparse_mod
+    leaves = jax.tree_util.tree_leaves(tensors,
+                                       is_leaf=sparse_mod.is_indexed_slices)
+    if any(sparse_mod.is_indexed_slices(l) for l in leaves):
+        from . import optim
+        return optim.allreduce_gradients(
+            tensors, compression=compression, average=average,
+            axis_name=axis_name, fusion_threshold=fusion_threshold)
     if cops.in_traced_context(axis_name):
         return cops.grouped_allreduce_traced(
             tensors, average=average, axis_name=axis_name,

@@ -28,25 +28,59 @@ from .ops.compression import Compression
 
 
 def allreduce_gradients(grads, compression=Compression.none, average=True,
-                        axis_name=None, fusion_threshold=None):
+                        axis_name=None, fusion_threshold=None,
+                        sparse_as_dense=False):
     """Average a gradient pytree across workers.
 
     Inside a traced context this emits one fused psum per fusion bucket;
     outside it delegates to the eager core. Identity when the worker axis is
     absent and there is a single process (matching hvd.size()==1 behaviour,
     torch/__init__.py:77: hooks are only registered when size() > 1).
+
+    ``IndexedSlices`` leaves take the sparse values+indices allgather path
+    (reference tensorflow/__init__.py:62-73) unless ``sparse_as_dense=True``,
+    which densifies them first (reference _keras/__init__.py:39-46).
     """
-    if cops.in_traced_context(axis_name):
-        return cops.grouped_allreduce_traced(
-            grads, average=average, axis_name=axis_name,
-            compression=compression, fusion_threshold=fusion_threshold)
-    return mpi_ops.grouped_allreduce(grads, average=average,
-                                     compression=compression)
+    from .ops import sparse as sparse_mod
+    # One flatten serves sparse detection, densification, and the dense
+    # path — the common all-dense case pays no extra tree traversal.
+    leaves, treedef = jax.tree_util.tree_flatten(
+        grads, is_leaf=sparse_mod.is_indexed_slices)
+    is_sparse = [sparse_mod.is_indexed_slices(l) for l in leaves]
+    if sparse_as_dense and any(is_sparse):
+        leaves = [sparse_mod.to_dense(l) if s else l
+                  for l, s in zip(leaves, is_sparse)]
+        is_sparse = [False] * len(leaves)
+
+    def _dense(dense_leaves):
+        if not dense_leaves:
+            return []
+        if cops.in_traced_context(axis_name):
+            return cops.grouped_allreduce_traced(
+                dense_leaves, average=average, axis_name=axis_name,
+                compression=compression, fusion_threshold=fusion_threshold)
+        return [mpi_ops.synchronize(h) for h in
+                [mpi_ops.allreduce_async(t, average=average,
+                                         compression=compression)
+                 for t in dense_leaves]]
+
+    if any(is_sparse):
+        dense_out = iter(_dense([l for l, s in zip(leaves, is_sparse)
+                                 if not s]))
+        out = [sparse_mod.sparse_allreduce(l, average=average,
+                                           axis_name=axis_name,
+                                           compression=compression)
+               if s else next(dense_out)
+               for l, s in zip(leaves, is_sparse)]
+    else:
+        out = _dense(leaves)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def DistributedOptimizer(optimizer, compression=Compression.none,
                          backward_passes_per_step=1, average=True,
-                         axis_name=None, fusion_threshold=None):
+                         axis_name=None, fusion_threshold=None,
+                         sparse_as_dense=False):
     """Wrap an ``optax.GradientTransformation`` so that ``update()`` first
     averages gradients across all workers.
 
@@ -63,9 +97,19 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
     """
     def _allreduce_updates(updates, state, params=None):
         del params
-        return allreduce_gradients(
+        from .ops import sparse as sparse_mod
+        reduced = allreduce_gradients(
             updates, compression=compression, average=average,
-            axis_name=axis_name, fusion_threshold=fusion_threshold), state
+            axis_name=axis_name, fusion_threshold=fusion_threshold,
+            sparse_as_dense=sparse_as_dense)
+        # IndexedSlices must not reach the inner optax transformation: it
+        # would tree-map over (values, indices) and corrupt the integer
+        # indices. Sparse leaves ride the allgather wire path above, then
+        # densify before apply (sparse_as_dense=True densified pre-wire).
+        return jax.tree_util.tree_map(
+            lambda l: sparse_mod.to_dense(l)
+            if sparse_mod.is_indexed_slices(l) else l,
+            reduced, is_leaf=sparse_mod.is_indexed_slices), state
 
     allreduce_tx = optax.GradientTransformation(
         init=lambda params: optax.EmptyState(),

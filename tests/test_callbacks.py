@@ -1,0 +1,217 @@
+"""Callback tests — parity with the reference Keras callback suite
+(_keras/callbacks.py; exercised in test/test_keras.py)."""
+
+import numpy as np
+import optax
+import pytest
+
+
+def _sgd_state(lr=0.1, momentum=0.9):
+    import jax.numpy as jnp
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=lr,
+                                             momentum=momentum)
+    params = {"w": jnp.ones((2, 2))}
+    return tx, params, tx.init(params)
+
+
+class TestHyperparamPlumbing:
+    def test_get_set_learning_rate(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=0.25)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == 0.25
+        assert cb.set_hyperparam(opt_state, "learning_rate", 0.5)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == 0.5
+
+    def test_nested_in_chain_and_multisteps(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu import callbacks as cb
+        tx = optax.MultiSteps(
+            optax.chain(optax.clip(1.0),
+                        optax.inject_hyperparams(optax.sgd)(
+                            learning_rate=0.1)), every_k_schedule=2)
+        opt_state = tx.init({"w": jnp.ones(3)})
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            0.1)
+        assert cb.set_hyperparam(opt_state, "learning_rate", 0.7)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            0.7)
+
+    def test_missing_returns_none(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state()
+        assert cb.get_hyperparam(opt_state, "nope") is None
+        assert not cb.set_hyperparam(opt_state, "nope", 1.0)
+
+
+class TestBroadcastCallback:
+    def test_broadcasts_on_train_begin(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu import callbacks as cb
+        tx, params, opt_state = _sgd_state()
+        loop = cb.LoopState(params=params, opt_state=opt_state)
+        cbs = cb.CallbackList([cb.BroadcastGlobalVariablesCallback(0)], loop)
+        cbs.on_train_begin()
+        np.testing.assert_allclose(np.asarray(loop.params["w"]),
+                                   np.ones((2, 2)))
+
+
+class TestMetricAverage:
+    def test_averages_logs(self, hvd):
+        from horovod_tpu import callbacks as cb
+        loop = cb.LoopState()
+        cbs = cb.CallbackList([cb.MetricAverageCallback()], loop)
+        logs = {"loss": 2.0, "acc": 0.5}
+        cbs.on_epoch_end(0, logs)
+        # single process: average over 1 participant = identity; types float
+        assert logs["loss"] == pytest.approx(2.0)
+        assert isinstance(logs["loss"], float)
+
+
+class TestLRSchedule:
+    def test_staircase_multiplier(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=0.1, momentum=0.9)
+        loop = cb.LoopState(opt_state=opt_state)
+        sched = cb.LearningRateScheduleCallback(
+            multiplier=lambda e: 0.1 ** e, start_epoch=0,
+            momentum_correction=False)
+        cbs = cb.CallbackList([sched], loop)
+        cbs.on_train_begin()
+        cbs.on_epoch_begin(1)
+        cbs.on_batch_begin(0)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            0.1 * 0.1)
+
+    def test_constant_multiplier_forces_staircase(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=1.0)
+        loop = cb.LoopState(opt_state=opt_state)
+        sched = cb.LearningRateScheduleCallback(multiplier=0.5,
+                                                momentum_correction=False)
+        cbs = cb.CallbackList([sched], loop)
+        cbs.on_train_begin()
+        cbs.on_epoch_begin(3)
+        cbs.on_batch_begin(0)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            0.5)
+
+    def test_momentum_correction_and_restore(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=0.1, momentum=0.9)
+        loop = cb.LoopState(opt_state=opt_state)
+        sched = cb.LearningRateScheduleCallback(
+            multiplier=lambda e: 2.0, momentum_correction=True)
+        cbs = cb.CallbackList([sched], loop)
+        cbs.on_train_begin()
+        cbs.on_epoch_begin(0)
+        cbs.on_batch_begin(0)
+        # momentum scaled by new_lr/old_lr = 2.0 during the batch
+        assert cb.get_hyperparam(opt_state, "momentum") == pytest.approx(1.8)
+        cbs.on_batch_end(0)
+        assert cb.get_hyperparam(opt_state, "momentum") == pytest.approx(0.9)
+
+    def test_outside_epoch_range_no_change(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=0.1)
+        loop = cb.LoopState(opt_state=opt_state)
+        sched = cb.LearningRateScheduleCallback(
+            multiplier=lambda e: 99.0, start_epoch=5,
+            momentum_correction=False)
+        cbs = cb.CallbackList([sched], loop)
+        cbs.on_train_begin()
+        cbs.on_epoch_begin(0)
+        cbs.on_batch_begin(0)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            0.1)
+
+    def test_logs_lr_on_epoch_end(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=0.3)
+        loop = cb.LoopState(opt_state=opt_state)
+        sched = cb.LearningRateScheduleCallback(multiplier=1.0,
+                                                momentum_correction=False)
+        cbs = cb.CallbackList([sched], loop)
+        cbs.on_train_begin()
+        logs = {}
+        cbs.on_epoch_end(0, logs)
+        assert logs["lr"] == pytest.approx(0.3)
+
+
+class TestWarmup:
+    def test_warmup_curve(self, hvd):
+        from horovod_tpu import callbacks as cb
+        _, _, opt_state = _sgd_state(lr=0.8, momentum=0.9)
+        loop = cb.LoopState(opt_state=opt_state, steps_per_epoch=10)
+        warm = cb.LearningRateWarmupCallback(warmup_epochs=5,
+                                             momentum_correction=False,
+                                             steps_per_epoch=10)
+        cbs = cb.CallbackList([warm], loop)
+        cbs.on_train_begin()
+        size = hvd.size()
+        # first batch of epoch 0: epoch_frac = 0 + 0/10 (+1/10 adjustment)
+        cbs.on_epoch_begin(0)
+        cbs.on_batch_begin(0)
+        e = 0.0 + 1.0 / 10
+        expect = 0.8 / size * (e * (size - 1) / 5 + 1)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            expect, rel=1e-5)
+        # end of warmup reaches the full LR
+        cbs.on_epoch_begin(4)
+        cbs.on_batch_begin(9)
+        e = 4 + 9 / 10 + 1 / 10
+        expect = 0.8 / size * (e * (size - 1) / 5 + 1)
+        assert cb.get_hyperparam(opt_state, "learning_rate") == pytest.approx(
+            expect, rel=1e-5)
+        assert expect == pytest.approx(0.8, rel=1e-5)
+
+    def test_warmup_schedule_matches_callback(self, hvd):
+        from horovod_tpu import callbacks as cb
+        size = hvd.size()
+        sched = cb.warmup_schedule(0.8, warmup_epochs=5, steps_per_epoch=10,
+                                   size=size)
+        # step 49 == last warmup step == full LR
+        assert float(sched(49)) == pytest.approx(0.8, rel=1e-5)
+        # after warmup stays at base
+        assert float(sched(200)) == pytest.approx(0.8)
+        # start ≈ base/size
+        e = 1.0 / 10
+        expect = 0.8 / size * (e * (size - 1) / 5 + 1)
+        assert float(sched(0)) == pytest.approx(expect, rel=1e-5)
+
+
+class TestFullLoopSmoke:
+    def test_callbacks_in_training_loop(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu import callbacks as cb
+
+        tx = hvd.DistributedOptimizer(
+            optax.inject_hyperparams(optax.sgd)(learning_rate=0.1,
+                                                momentum=0.9))
+        params = {"w": jnp.ones((4,))}
+        opt_state = tx.init(params)
+        loop = cb.LoopState(params=params, opt_state=opt_state,
+                            steps_per_epoch=2)
+        cbs = cb.CallbackList(
+            [cb.BroadcastGlobalVariablesCallback(0),
+             cb.MetricAverageCallback(),
+             cb.LearningRateWarmupCallback(warmup_epochs=2,
+                                           steps_per_epoch=2)], loop)
+
+        def loss_fn(p, x):
+            return jnp.sum((p["w"] * x) ** 2)
+
+        cbs.on_train_begin()
+        x = jnp.arange(4.0)
+        for epoch in range(3):
+            cbs.on_epoch_begin(epoch)
+            for batch in range(2):
+                cbs.on_batch_begin(batch)
+                grads = jax.grad(loss_fn)(loop.params, x)
+                updates, loop.opt_state = tx.update(
+                    grads, loop.opt_state, loop.params)
+                loop.params = optax.apply_updates(loop.params, updates)
+                cbs.on_batch_end(batch)
+            logs = {"loss": float(loss_fn(loop.params, x))}
+            cbs.on_epoch_end(epoch, logs)
+        assert np.isfinite(logs["loss"])

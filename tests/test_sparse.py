@@ -1,0 +1,174 @@
+"""Sparse-gradient (IndexedSlices) tests — parity with the reference's
+IndexedSlices→allgather allreduce (tensorflow/__init__.py:62-73) and
+sparse_as_dense densification (_keras/__init__.py:39-46)."""
+
+import numpy as np
+import pytest
+
+
+def _traced(hvd, fn, *args, in_specs=None, out_specs=None):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = hvd.mesh()
+    in_specs = in_specs if in_specs is not None else P("hvd")
+    out_specs = out_specs if out_specs is not None else P("hvd")
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))(*args)
+
+
+class TestIndexedSlices:
+    def test_pytree_roundtrip(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        s = hvd.IndexedSlices(jnp.ones((2, 3)), jnp.array([0, 4]), (10, 3))
+        leaves, treedef = jax.tree_util.tree_flatten(s)
+        assert len(leaves) == 2
+        s2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert s2.dense_shape == (10, 3)
+
+    def test_to_dense_accumulates_duplicates(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu.ops import sparse
+        s = hvd.IndexedSlices(jnp.ones((3, 2)), jnp.array([1, 1, 4]), (6, 2))
+        d = sparse.to_dense(s)
+        expect = np.zeros((6, 2))
+        expect[1] = 2.0
+        expect[4] = 1.0
+        np.testing.assert_allclose(np.asarray(d), expect)
+
+    def test_from_dense(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu.ops import sparse
+        d = jnp.arange(12.0).reshape(6, 2)
+        s = sparse.from_dense(d, [2, 5])
+        np.testing.assert_allclose(np.asarray(s.values),
+                                   np.asarray(d)[[2, 5]])
+        assert s.dense_shape == (6, 2)
+
+
+class TestSparseAllreduce:
+    def test_eager_single_process_identity(self, hvd):
+        # single process eagerly = single-rank horovod: allreduce is the
+        # identity (same semantics as the dense eager replicated path).
+        import jax.numpy as jnp
+        from horovod_tpu.ops import sparse
+        s = hvd.IndexedSlices(jnp.ones((2, 3)), jnp.array([1, 3]), (5, 3))
+        out = hvd.sparse_allreduce(s, average=True)
+        dense = sparse.to_dense(out)
+        expect = np.zeros((5, 3))
+        expect[1] = 1.0
+        expect[3] = 1.0
+        np.testing.assert_allclose(np.asarray(dense), expect, rtol=1e-6)
+
+    def test_traced_matches_dense_allreduce(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.ops import sparse
+
+        # worker i contributes row i with value i (rank-dependent data)
+        vals = jnp.arange(8.0).reshape(8, 1, 1) * jnp.ones((8, 1, 4))
+        idxs = jnp.arange(8, dtype=jnp.int32).reshape(8, 1)
+
+        def fn(v, i):
+            s = hvd.IndexedSlices(v[0], i[0], (8, 4))
+            out = hvd.sparse_allreduce(s, average=False)
+            return sparse.to_dense(out)[None]
+
+        dense = _traced(hvd, fn, vals, idxs,
+                        in_specs=(P("hvd"), P("hvd")), out_specs=P("hvd"))
+        # every worker's block is the union: row i == i
+        blocks = np.asarray(dense).reshape(8, 8, 4)
+        expect = np.tile(np.arange(8.0)[:, None], (1, 4))
+        for b in blocks:
+            np.testing.assert_allclose(b, expect)
+
+    def test_allreduce_dispatches_indexed_slices(self, hvd):
+        import jax.numpy as jnp
+        s = hvd.IndexedSlices(jnp.ones((1, 2)), jnp.array([0]), (4, 2))
+        out = hvd.allreduce(s, average=False)
+        assert isinstance(out, hvd.IndexedSlices)
+        assert out.dense_shape == (4, 2)
+
+    def test_sparse_rejects_min_max(self, hvd):
+        import jax.numpy as jnp
+        s = hvd.IndexedSlices(jnp.ones((1, 2)), jnp.array([0]), (4, 2))
+        with pytest.raises(ValueError, match="sum/average"):
+            hvd.allreduce(s, op="min")
+
+    def test_grouped_allreduce_routes_sparse(self, hvd):
+        # indices must never be summed as dense tensors
+        import jax.numpy as jnp
+        tree = {
+            "embed": hvd.IndexedSlices(jnp.ones((2, 3)),
+                                       jnp.array([1, 3]), (5, 3)),
+            "w": jnp.full((2, 2), 3.0),
+        }
+        out = hvd.grouped_allreduce(tree, average=False)
+        assert isinstance(out["embed"], hvd.IndexedSlices)
+        assert out["embed"].indices.dtype == tree["embed"].indices.dtype
+        np.testing.assert_array_equal(np.asarray(out["embed"].indices),
+                                      [1, 3])
+        np.testing.assert_allclose(np.asarray(out["w"]),
+                                   np.full((2, 2), 3.0))
+
+    def test_sparse_fp16_compression(self, hvd):
+        import jax.numpy as jnp
+        s = hvd.IndexedSlices(jnp.ones((2, 3), jnp.float32),
+                              jnp.array([0, 1]), (4, 3))
+        out = hvd.allreduce(s, average=False,
+                            compression=hvd.Compression.fp16)
+        assert out.values.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out.values), np.ones((2, 3)))
+
+
+class TestSparseGradientTree:
+    def test_mixed_tree_allreduce(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu import optim
+        grads = {
+            "embed": hvd.IndexedSlices(jnp.ones((2, 3)), jnp.array([0, 1]),
+                                       (4, 3)),
+            "w": jnp.full((2, 2), 2.0),
+        }
+        out = optim.allreduce_gradients(grads, average=False)
+        assert isinstance(out["embed"], hvd.IndexedSlices)
+        assert out["embed"].dense_shape == (4, 3)
+        # single-process eager: allreduce over 1 participant = identity
+        np.testing.assert_allclose(np.asarray(out["w"]), np.full((2, 2), 2.0))
+
+    def test_distributed_optimizer_densifies_sparse(self, hvd):
+        # IndexedSlices must never reach the inner optax transform
+        import jax.numpy as jnp
+        import optax
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        params = {"embed": jnp.ones((4, 3))}
+        opt_state = tx.init(params)
+        grads = {"embed": hvd.IndexedSlices(jnp.ones((2, 3)),
+                                            jnp.array([0, 2]), (4, 3))}
+        updates, opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        assert not isinstance(new_params["embed"], hvd.IndexedSlices)
+        got = np.asarray(new_params["embed"])
+        np.testing.assert_allclose(got[0], 1.0 - 0.1)  # touched rows moved
+        np.testing.assert_allclose(got[1], 1.0)        # untouched intact
+
+    def test_eager_op_sum_not_averaged(self, hvd):
+        out = hvd.allreduce(np.arange(8.0).reshape(8, 1), op="sum")
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+        with pytest.raises(NotImplementedError):
+            hvd.allreduce(np.ones((2, 2)), op="min")
+
+    def test_sparse_as_dense(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu import optim
+        grads = {
+            "embed": hvd.IndexedSlices(jnp.ones((2, 3)), jnp.array([0, 0]),
+                                       (4, 3)),
+        }
+        out = optim.allreduce_gradients(grads, average=False,
+                                        sparse_as_dense=True)
+        assert not isinstance(out["embed"], hvd.IndexedSlices)
+        expect = np.zeros((4, 3))
+        expect[0] = 2.0  # duplicates accumulate; 1 participant eager
+        np.testing.assert_allclose(np.asarray(out["embed"]), expect)
