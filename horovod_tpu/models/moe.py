@@ -1,0 +1,103 @@
+"""Mixture-of-Experts layer with expert parallelism over the 'ep' mesh axis.
+
+The reference has no MoE/expert parallelism (SURVEY.md §2.6); this is a
+capability extension the task spec makes first-class. TPU-first design is
+the GShard/Switch pattern, not a per-device gather/scatter runtime:
+
+  * Routing, dispatch and combine are dense einsums over one-hot
+    capacity-limited masks — static shapes, jit-clean, MXU-friendly.
+  * Expert weights are stacked [E, ...] and sharded over 'ep' via
+    PartitionSpecs; under GSPMD jit, XLA inserts the all-to-alls that move
+    token slots to their expert's shard and back (the ICI-native analogue
+    of an MoE all_to_all dispatch layer).
+  * Over-capacity tokens are dropped (their combine weight is zero) — the
+    standard capacity-factor trade that keeps shapes static for XLA.
+  * A Switch-style load-balance auxiliary loss is exposed via
+    ``sow('losses', 'moe_aux_loss', ...)``; training steps can pull it from
+    the mutable collection and add ``aux_weight *`` it to the task loss.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Gated (SwiGLU) expert FFN with top-k routing and fixed capacity.
+
+    Drop-in replacement for models.transformer.MLP when
+    cfg.num_experts > 0.
+    """
+    cfg: object  # TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E = cfg.num_experts
+        k = cfg.num_experts_per_tok
+        b, s, d = x.shape
+        # capacity per expert per batch row: factor × fair share
+        capacity = max(1, int(cfg.expert_capacity_factor * s * k / E))
+
+        # --- routing (fp32 for numerics) ---
+        router_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                                 name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)      # [b, s, E]
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)       # [b, s, k]
+        gate_vals = gate_vals / jnp.clip(
+            gate_vals.sum(-1, keepdims=True), 1e-9)         # renormalize
+
+        # --- capacity assignment: sequential priority over the k slots ---
+        # position_in_expert for slot j counts tokens of slots 0..j to keep
+        # slot-0 (highest gate) tokens first in line for capacity.
+        combine = jnp.zeros((b, s, E, capacity), jnp.float32)
+        prev_counts = jnp.zeros((b, 1, E), jnp.int32)  # tokens already taken
+        for j in range(k):
+            mask_j = jax.nn.one_hot(gate_idx[..., j], E,
+                                    dtype=jnp.int32)        # [b, s, E]
+            pos_j = (jnp.cumsum(mask_j, axis=1) - mask_j
+                     + prev_counts) * mask_j                # [b, s, E]
+            prev_counts = prev_counts + mask_j.sum(
+                axis=1, keepdims=True)
+            within = (pos_j < capacity) & (mask_j > 0)
+            pos_oh = jax.nn.one_hot(pos_j, capacity,
+                                    dtype=jnp.float32)      # [b, s, E, C]
+            combine = combine + (gate_vals[..., j][..., None, None]
+                                 * within[..., None] * pos_oh)
+        dispatch = (combine > 0).astype(cfg.dtype)          # [b, s, E, C]
+
+        # --- load-balance aux loss (Switch: E * Σ_e f_e · P_e) ---
+        token_frac = jnp.mean(
+            jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32),
+            axis=(0, 1))
+        prob_frac = jnp.mean(probs, axis=(0, 1))
+        self.sow("losses", "moe_aux_loss",
+                 E * jnp.sum(token_frac * prob_frac))
+
+        # --- dispatch → expert FFN → combine (XLA shards E over 'ep') ---
+        xd = x.astype(cfg.dtype)
+        expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch, xd)
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
+                            (E, d, cfg.d_ff), jnp.float32).astype(cfg.dtype)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (E, d, cfg.d_ff), jnp.float32).astype(cfg.dtype)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (E, cfg.d_ff, d), jnp.float32).astype(cfg.dtype)
+        h = (nn.silu(jnp.einsum("ebcm,emf->ebcf", expert_in, w_gate))
+             * jnp.einsum("ebcm,emf->ebcf", expert_in, w_up))
+        expert_out = jnp.einsum("ebcf,efm->ebcm", h, w_down)
+        out = jnp.einsum("bsec,ebcm->bsm", combine.astype(cfg.dtype),
+                         expert_out)
+        return out.astype(cfg.dtype)
+
+
+def aux_loss_from(mutables, weight=0.01):
+    """Sum every sown moe_aux_loss in a mutable-collection dict (as returned
+    by ``model.apply(..., mutable=['losses'])``), scaled by ``weight``."""
+    total = 0.0
+    losses = mutables.get("losses", {}) if mutables else {}
+    for leaf in jax.tree_util.tree_leaves(losses):
+        total = total + jnp.sum(leaf)
+    return weight * total

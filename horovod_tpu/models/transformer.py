@@ -41,6 +41,11 @@ class TransformerConfig:
     # inside shard_map with the 'sp' axis bound (parallel/ring.py); under
     # plain GSPMD jit the full path is used and XLA inserts gathers.
     attention_impl: str = "full"
+    # Mixture-of-Experts: num_experts > 0 replaces the dense MLP with
+    # models/moe.py's expert layer (experts shard over the 'ep' mesh axis).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 1.25
 
     @classmethod
     def tiny(cls, **kw):
@@ -153,7 +158,11 @@ class Block(nn.Module):
         y = nn.RMSNorm(dtype=cfg.dtype, name="ln_attn")(x)
         x = x + Attention(cfg, sp=self.sp, name="attn")(y, positions)
         y = nn.RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x)
-        x = x + MLP(cfg, name="mlp")(y)
+        if cfg.num_experts > 0:
+            from .moe import MoEMLP
+            x = x + MoEMLP(cfg, name="mlp")(y)
+        else:
+            x = x + MLP(cfg, name="mlp")(y)
         return x
 
 
@@ -195,6 +204,11 @@ _TP_RULES = (
     (("mlp", "gate", "kernel"), P(None, "tp")),
     (("mlp", "up", "kernel"), P(None, "tp")),
     (("mlp", "down", "kernel"), P("tp", None)),
+    # MoE expert stacks: experts over 'ep', ffn dim over 'tp'
+    (("mlp", "w_gate"), P("ep", None, "tp")),
+    (("mlp", "w_up"), P("ep", None, "tp")),
+    (("mlp", "w_down"), P("ep", "tp", None)),
+    (("mlp", "router", "kernel"), P()),
     (("lm_head", "kernel"), P(None, "tp")),          # vocab-sharded head
     (("embed", "embedding"), P(None, None)),
 )
@@ -220,6 +234,30 @@ def batch_spec(sp=False):
     """Activation sharding for [batch, seq] token arrays: batch over 'dp',
     sequence over 'sp' when sequence parallelism is on."""
     return P("dp", "sp" if sp else None)
+
+
+def lm_loss_fn(model, aux_weight=0.01):
+    """Next-token loss for TransformerLM that automatically includes the
+    MoE load-balance auxiliary loss when cfg.num_experts > 0.
+
+    Use this (or replicate its mutable=['losses'] plumbing) for MoE
+    configs: a plain ``model.apply`` without the mutable collection
+    silently discards the sown aux loss and the router trains with no
+    load-balancing pressure.
+    """
+    from .. import trainer as trainer_mod
+
+    def loss_fn(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if model.cfg.num_experts > 0:
+            from .moe import aux_loss_from
+            logits, mut = model.apply({"params": params}, inputs,
+                                      mutable=["losses"])
+            return (trainer_mod.softmax_cross_entropy(logits, targets)
+                    + aux_loss_from(mut, weight=aux_weight))
+        logits = model.apply({"params": params}, inputs)
+        return trainer_mod.softmax_cross_entropy(logits, targets)
+    return loss_fn
 
 
 def init_params(cfg, rng, batch_size=2, seq_len=None):

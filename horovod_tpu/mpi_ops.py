@@ -119,13 +119,16 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
     ``IndexedSlices`` input takes the sparse allgather path (reference
     tensorflow/__init__.py:62-73).
     """
+    # Normalize sum/average into the `average` flag once; after this, op is
+    # None or min/max (which only the traced dense branch implements).
+    if op in (cops.SUM, cops.AVERAGE):
+        average = op == cops.AVERAGE
+        op = None
     from .ops import sparse as sparse_mod
     if sparse_mod.is_indexed_slices(tensor):
-        if op not in (None, cops.SUM, cops.AVERAGE):
+        if op is not None:
             raise ValueError(
                 f"Sparse allreduce supports only sum/average, got op={op!r}")
-        if op is not None:
-            average = op == cops.AVERAGE
         return sparse_mod.sparse_allreduce(tensor, average=average,
                                            axis_name=axis_name, name=name,
                                            compression=compression)
@@ -133,13 +136,10 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
         return cops.allreduce_traced(tensor, average=average,
                                      axis_name=axis_name, op=op,
                                      compression=compression)
-    # Eager branch must honor op the same way the traced branch does.
-    if op not in (None, cops.SUM, cops.AVERAGE):
+    if op is not None:
         raise NotImplementedError(
             f"Eager allreduce supports only sum/average, got op={op!r}; "
             "min/max are available inside shard_map-traced code.")
-    if op is not None:
-        average = op == cops.AVERAGE
     handle = allreduce_async(tensor, average=average, name=name,
                              compression=compression)
     return synchronize(handle)
@@ -199,10 +199,10 @@ def allgather(tensor, name=None, axis_name=None):
     return synchronize(allgather_async(tensor, name=name))
 
 
-def allgather_async(tensor, name=None):
+def allgather_async(tensor, name=None, kind=None):
     coord = _coordinator()
     return coord.enqueue(_auto_name("allgather", name), eager_mod.ALLGATHER,
-                         tensor)
+                         tensor, kind=kind)
 
 
 # ---------------------------------------------------------------------------

@@ -212,14 +212,18 @@ class EagerCoordinator:
 
     # -- enqueue API (EnqueueTensorAllreduce/..., operations.cc:1654-1770) --
 
-    def enqueue(self, name, op, tensor, root_rank=0, average=False):
+    def enqueue(self, name, op, tensor, root_rank=0, average=False,
+                kind=None):
         if self._shutdown:
             raise ShutdownError()
         if op == BROADCAST and not 0 <= root_rank < self._world:
             raise MismatchError(
                 f"Invalid root_rank {root_rank} for broadcast '{name}': "
                 f"must be in [0, {self._world}).")
-        entry_kind = self._classify(tensor)
+        # kind overrides the shape heuristic for callers that know their
+        # tensor's semantics (e.g. sparse values whose nnz happens to equal
+        # the world size must not be reinterpreted as stacked).
+        entry_kind = kind if kind is not None else self._classify(tensor)
         with self._queue_lock:
             if name in self._tensor_table:
                 raise DuplicateNameError(name)

@@ -90,22 +90,30 @@ def sparse_allreduce(slices, average=True, axis_name=None, name=None,
     if cops.in_traced_context(axis_name):
         values = cops.allgather_traced(values, axis_name=axis_name)
         indices = cops.allgather_traced(slices.indices, axis_name=axis_name)
-        if average:
-            values = values / jax.lax.axis_size(
-                cops.resolve_axis(axis_name))
+        divisor = jax.lax.axis_size(cops.resolve_axis(axis_name))
     else:
         from .. import mpi_ops
-        values = mpi_ops.allgather(
-            values, name=None if name is None else f"{name}.values")
-        indices = mpi_ops.allgather(
-            slices.indices, name=None if name is None else f"{name}.indices")
-        if average:
-            # Divide by the number of eager participants (processes), not a
-            # shape ratio: workers may contribute unequal nnz, and the
-            # divisor must be identical on every worker for the replicas to
-            # stay in sync. One process → identity, matching the dense eager
-            # single-rank semantics.
-            values = values / mpi_ops.process_count()
+        # kind='replicated': these are per-process values, never the eager
+        # core's stacked-leading-dim convention — without the override, an
+        # nnz that happens to equal the device count would be misclassified.
+        values = mpi_ops.synchronize(mpi_ops.allgather_async(
+            values, name=None if name is None else f"{name}.values",
+            kind="replicated"))
+        indices = mpi_ops.synchronize(mpi_ops.allgather_async(
+            slices.indices,
+            name=None if name is None else f"{name}.indices",
+            kind="replicated"))
+        # Divide by the number of eager participants (processes), not a
+        # shape ratio: workers may contribute unequal nnz, and the divisor
+        # must be identical on every worker for the replicas to stay in
+        # sync. One process → identity, matching the dense eager
+        # single-rank semantics.
+        divisor = mpi_ops.process_count()
+    # decompress BEFORE dividing so the average happens in the restored
+    # dtype (parity with the dense path: compress → wire → decompress →
+    # divide; fp16 wire values would lose precision if divided first).
     if ctx is not None:
         values = compression.decompress(values, ctx)
+    if average:
+        values = values / divisor
     return IndexedSlices(values, indices, slices.dense_shape)

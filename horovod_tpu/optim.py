@@ -116,7 +116,24 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
         update=_allreduce_updates)
     tx = optax.chain(allreduce_tx, optimizer)
     if backward_passes_per_step > 1:
-        tx = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
+        multi = optax.MultiSteps(tx,
+                                 every_k_schedule=backward_passes_per_step)
+        # MultiSteps accumulates into dense zeros_like(params) buffers, so
+        # IndexedSlices must densify BEFORE the accumulator — local dense
+        # accumulation matches the reference's grad buffers
+        # (torch/__init__.py:114-130); the allreduce inside still sees
+        # dense grads once per k steps.
+        from .ops import sparse as sparse_mod
+
+        def _densify_then(updates, state, params=None):
+            dense = jax.tree_util.tree_map(
+                lambda l: sparse_mod.to_dense(l)
+                if sparse_mod.is_indexed_slices(l) else l,
+                updates, is_leaf=sparse_mod.is_indexed_slices)
+            return multi.update(dense, state, params)
+
+        tx = optax.GradientTransformation(init=multi.init,
+                                          update=_densify_then)
     return tx
 
 

@@ -1,0 +1,254 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the 'pp' mesh
+axis.
+
+The reference implements no pipeline parallelism (SURVEY.md §2.6: data
+parallelism only); this is a capability extension the task spec makes
+first-class. The design is TPU-idiomatic rather than a port of any
+GPU/NCCL send/recv scheme:
+
+  * Stages are pp-mesh shards inside ``shard_map``: every rank runs the SAME
+    compiled SPMD program; "send to next stage" is ``lax.ppermute`` over ICI
+    (a neighbour hop on the torus — the cheapest possible collective).
+  * The schedule is a ``lax.scan`` over M + P - 1 ticks (M microbatches,
+    P stages): compiler-friendly static control flow, no per-step host
+    involvement, fully differentiable (ppermute's transpose is the reverse
+    permute, so jax.grad derives the backward pipeline automatically).
+  * Bubble ticks compute on garbage activations; their outputs are never
+    read, so their gradients are exactly zero and correctness is unaffected
+    — the standard GPipe trade (bubble fraction (P-1)/(M+P-1)).
+
+``gpipe`` is the generic primitive; ``make_pipeline_step`` builds a full
+dp × pp training step for the flagship transformer (models/transformer.py),
+with layer stacks sharded over 'pp' and embedding/head/final-norm replicated
+(their gradients are pp-summed — each is only *used* on one stage, so the
+sum recovers the true gradient).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe(stage_fn, microbatches, axis_name="pp"):
+    """Run ``stage_fn`` as one stage of a GPipe pipeline. Must be called
+    inside ``shard_map`` with ``axis_name`` bound.
+
+    Args:
+      stage_fn: activation -> activation, this rank's stage (same output
+        shape/dtype as input — homogeneous-block pipelines; put embed/head
+        outside the pipeline).
+      microbatches: [M, ...] stacked microbatch activations, replicated
+        across the pp axis (only stage 0 reads them).
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      [M, ...] outputs, valid on the LAST stage (zeros elsewhere); use
+      ``last_stage_value`` to broadcast results to every stage.
+    """
+    stage = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    num_micro = microbatches.shape[0]
+    ticks = num_micro + n_stages - 1
+
+    # the carry becomes device-varying over pp after the first ppermute /
+    # stage-masked write; mark it varying from the start so the scan's
+    # carry type is stable
+    state = lax.pcast(jnp.zeros_like(microbatches[0]), (axis_name,),
+                      to="varying")
+    outputs = lax.pcast(jnp.zeros_like(microbatches), (axis_name,),
+                        to="varying")
+
+    def tick(carry, t):
+        state, outputs = carry
+        inject = microbatches[jnp.clip(t, 0, num_micro - 1)]
+        x_in = jnp.where(stage == 0, inject, state)
+        y = stage_fn(x_in)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, num_micro - 1)
+        take = jnp.logical_and(t >= n_stages - 1, stage == n_stages - 1)
+        outputs = jnp.where(take, outputs.at[out_idx].set(y), outputs)
+        # neighbour hop: stage i's output becomes stage i+1's next input
+        state = lax.ppermute(y, axis_name,
+                             [(i, i + 1) for i in range(n_stages - 1)])
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(ticks))
+    return outputs
+
+
+def last_stage_value(x, axis_name="pp"):
+    """Broadcast a value computed on the last pipeline stage to all stages
+    (masked psum — lowers to a one-to-all over ICI)."""
+    stage = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    return lax.psum(jnp.where(stage == n_stages - 1, x, jnp.zeros_like(x)),
+                    axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Transformer pipeline step (dp × pp)
+# ---------------------------------------------------------------------------
+
+def stack_pipeline_params(params, num_layers):
+    """Convert TransformerLM params ({'layer_0'..'layer_{L-1}', 'embed',
+    'ln_f', 'lm_head'}) into pipeline layout: {'layers': stacked-[L, ...],
+    'embed', 'ln_f', 'lm_head'}. The stacked leading axis shards over 'pp'."""
+    layers = [params[f"layer_{i}"] for i in range(num_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    rest = {k: v for k, v in params.items() if not k.startswith("layer_")}
+    return {"layers": stacked, **rest}
+
+
+def unstack_pipeline_params(pparams, num_layers):
+    """Inverse of stack_pipeline_params (e.g. for checkpointing in the
+    canonical layout)."""
+    out = {k: v for k, v in pparams.items() if k != "layers"}
+    for i in range(num_layers):
+        out[f"layer_{i}"] = jax.tree_util.tree_map(
+            lambda x, i=i: x[i], pparams["layers"])
+    return out
+
+
+def pipeline_param_specs(pparams):
+    """PartitionSpecs for the pipeline layout: layer stack sharded over
+    'pp' on the leading axis, everything else replicated."""
+    def spec(path, leaf):
+        top = str(getattr(path[0], "key", getattr(path[0], "name", path[0])))
+        if top == "layers":
+            return P("pp")
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, pparams)
+
+
+def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
+                       dp_axis="dp", pp_axis="pp"):
+    """Build a jitted dp × pp training step for TransformerLM.
+
+    The layer stack is split over ``pp_axis`` (layers_per_stage =
+    num_layers / pp); the batch over ``dp_axis``; microbatches flow through
+    stages via the gpipe schedule. Gradients: dp-mean over ``dp_axis`` for
+    everything (the DistributedOptimizer role, done explicitly here because
+    replicated-vs-stacked params need different pp treatment), plus pp-sum
+    for the replicated embed/head/norm params, which only one stage touches.
+
+    Args: ``pparams`` is the stacked layout from ``stack_pipeline_params``
+    (used for shape/spec inference — pass the actual params or shapes).
+
+    Returns (step, pparam_shardings, batch_sharding); step(pparams,
+    opt_state, tokens[b, S+1]) -> (pparams, opt_state, loss).
+    """
+    from ..models.transformer import Block
+    from .. import trainer as trainer_mod
+    import flax.linen as nn
+
+    pp = mesh.shape[pp_axis]
+    dp = mesh.shape[dp_axis]
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by pp={pp}")
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "make_pipeline_step does not yet thread the MoE aux loss "
+            "through the pipeline (the sown 'losses' collection would be "
+            "silently dropped inside lax.scan); use make_gspmd_step with "
+            "models.transformer.lm_loss_fn for MoE configs.")
+    block = Block(cfg, sp=None)
+    ln_f = nn.RMSNorm(dtype=cfg.dtype)
+
+    def per_rank_loss(pparams, tokens):
+        # tokens: [b_loc, S+1] — inputs + shifted targets
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        b_loc, s = inputs.shape
+        if b_loc % num_microbatches:
+            raise ValueError(
+                f"local batch {b_loc} not divisible by "
+                f"num_microbatches={num_microbatches}")
+        x = pparams["embed"]["embedding"][inputs].astype(cfg.dtype)
+        positions = jnp.arange(s)[None, :]
+        mb = b_loc // num_microbatches
+        x = x.reshape(num_microbatches, mb, s, cfg.d_model)
+
+        def stage_fn(act):
+            def body(a, layer_params):
+                return block.apply({"params": layer_params}, a,
+                                   positions), None
+            act, _ = lax.scan(body, act, pparams["layers"])
+            return act
+
+        y = gpipe(stage_fn, x, axis_name=pp_axis)  # valid on last stage
+        y = y.reshape(b_loc, s, cfg.d_model)
+        y = ln_f.apply({"params": pparams["ln_f"]}, y)
+        logits = (y @ pparams["lm_head"]["kernel"].astype(cfg.dtype)
+                  ).astype(jnp.float32)
+        loss = trainer_mod.softmax_cross_entropy(logits, targets)
+        # only the last stage computed a real loss; share it
+        return last_stage_value(loss, pp_axis)
+
+    import optax
+
+    def step(pparams, opt_state, tokens):
+        loss, grads = jax.value_and_grad(per_rank_loss)(pparams, tokens)
+        # dp-average everything; pp-sum the replicated (non-stacked) params
+        # — each is used on exactly one stage, so the sum is the true grad.
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, dp_axis) / dp, grads)
+        grads = {k: (v if k == "layers" else
+                     jax.tree_util.tree_map(
+                         lambda g: lax.psum(g, pp_axis), v))
+                 for k, v in grads.items()}
+        updates, opt_state = tx.update(grads, opt_state, pparams)
+        pparams = optax.apply_updates(pparams, updates)
+        return pparams, opt_state, lax.pmean(loss, dp_axis)
+
+    param_specs_tree = pipeline_param_specs(pparams)
+    opt_state_shape = jax.eval_shape(tx.init, pparams)
+    opt_specs = _mirror_opt_specs(opt_state_shape, pparams,
+                                  param_specs_tree)
+    batch_spec = P(dp_axis, None)
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(param_specs_tree, opt_specs, batch_spec),
+        out_specs=(param_specs_tree, opt_specs, P())))
+
+    def shardings(spec_tree):
+        return jax.tree_util.tree_map(
+            lambda s: jax.sharding.NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda s: isinstance(s, P))
+
+    return fn, shardings(param_specs_tree), \
+        jax.sharding.NamedSharding(mesh, batch_spec)
+
+
+def _mirror_opt_specs(opt_state_shape, params, param_specs_tree):
+    """Give each optimizer-state leaf the spec of the parameter it mirrors.
+
+    Optimizer states embed param-shaped subtrees under the same dict keys
+    as the params (optax mu/nu/trace buffers), so a state leaf's key-path
+    suffix identifies its parameter deterministically; the shape must also
+    match, guarding against coincidental key collisions. Anything without a
+    matching (path-suffix, shape) — counts, scalars, schedules — is
+    replicated."""
+    def path_keys(path):
+        return tuple(str(getattr(p, "key", getattr(p, "name", None)))
+                     for p in path
+                     if hasattr(p, "key") or hasattr(p, "name"))
+
+    # params and param_specs_tree have identical structure (the specs are
+    # built by tree_map over the params), so parallel flattening aligns
+    # each param path with its spec.
+    param_leaves = jax.tree_util.tree_leaves_with_path(params)
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs_tree, is_leaf=lambda s: isinstance(s, P))
+    by_path = {path_keys(path): (tuple(leaf.shape), spec)
+               for (path, leaf), spec in zip(param_leaves, spec_leaves)}
+
+    def spec_for(path, leaf):
+        keys = path_keys(path)
+        for i in range(len(keys)):
+            hit = by_path.get(keys[i:])
+            if hit is not None and hit[0] == tuple(leaf.shape):
+                return hit[1]
+        return P()
+    return jax.tree_util.tree_map_with_path(spec_for, opt_state_shape)

@@ -159,6 +159,37 @@ class TestSparseGradientTree:
         with pytest.raises(NotImplementedError):
             hvd.allreduce(np.ones((2, 2)), op="min")
 
+    def test_eager_nnz_equal_to_world_size(self, hvd):
+        # nnz == device count must not trip the eager core's stacked-array
+        # heuristic (values would be reshaped, 1-D indices would crash)
+        import jax.numpy as jnp
+        from horovod_tpu.ops import sparse
+        n = hvd.size()
+        s = hvd.IndexedSlices(jnp.ones((n, 3)),
+                              jnp.arange(n, dtype=jnp.int32), (2 * n, 3))
+        out = hvd.sparse_allreduce(s, average=True)
+        assert out.values.shape == (n, 3)
+        assert out.indices.shape == (n,)
+        dense = sparse.to_dense(out)
+        np.testing.assert_allclose(np.asarray(dense[:n]), np.ones((n, 3)))
+
+    def test_multisteps_accumulates_sparse(self, hvd):
+        # backward_passes_per_step > 1 densifies before the accumulator
+        import jax.numpy as jnp
+        import optax
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                      backward_passes_per_step=2)
+        params = {"embed": jnp.zeros((4, 3))}
+        opt_state = tx.init(params)
+        grads = {"embed": hvd.IndexedSlices(jnp.ones((2, 3)),
+                                            jnp.array([0, 2]), (4, 3))}
+        for _ in range(2):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        got = np.asarray(params["embed"])
+        np.testing.assert_allclose(got[0], -0.1, rtol=1e-6)  # mean of 2
+        np.testing.assert_allclose(got[1], 0.0)
+
     def test_sparse_as_dense(self, hvd):
         import jax.numpy as jnp
         from horovod_tpu import optim
