@@ -82,6 +82,11 @@ def _dispatch_attention(cfg, q, k, v, sp):
     sequence-sharding axis (None when the sequence is whole on this
     worker)."""
     from ..parallel import ring
+    known = ("full", "ring", "ulysses", "flash")
+    if cfg.attention_impl not in known:
+        raise ValueError(
+            f"Unknown attention_impl={cfg.attention_impl!r}; "
+            f"expected one of {known}.")
     if sp is not None:
         if cfg.attention_impl == "ring":
             return ring.ring_attention(q, k, v, axis_name=sp, causal=True)
@@ -92,6 +97,9 @@ def _dispatch_attention(cfg, q, k, v, sp):
             f"attention_impl={cfg.attention_impl!r} cannot attend across "
             "shards — construct the model with attention_impl='ring' or "
             "'ulysses' for sequence parallelism.")
+    if cfg.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
     return ring.full_attention(q, k, v, causal=True)
 
 

@@ -191,12 +191,14 @@ def grouped_allreduce(tensors, average=True, compression=Compression.none,
 # allgather
 # ---------------------------------------------------------------------------
 
-def allgather(tensor, name=None, axis_name=None):
+def allgather(tensor, name=None, axis_name=None, kind=None):
     """Concatenate each worker's tensor along dim 0 (reference
-    torch/mpi_ops.py:180-232; MPI_Allgatherv mpi_operations.cc:86-173)."""
+    torch/mpi_ops.py:180-232; MPI_Allgatherv mpi_operations.cc:86-173).
+    ``kind`` overrides the eager core's stacked/replicated shape heuristic
+    for callers that know their tensor's semantics."""
     if cops.in_traced_context(axis_name):
         return cops.allgather_traced(tensor, axis_name=axis_name)
-    return synchronize(allgather_async(tensor, name=name))
+    return synchronize(allgather_async(tensor, name=name, kind=kind))
 
 
 def allgather_async(tensor, name=None, kind=None):

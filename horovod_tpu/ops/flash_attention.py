@@ -1,0 +1,174 @@
+"""Fused (flash) attention as a Pallas TPU kernel.
+
+The hot op of the flagship transformer. XLA's default attention
+materializes the [s, s] logits in HBM; this kernel keeps K/V in HBM and
+streams block_k-sized tiles into double-buffered VMEM scratch with async
+DMA, maintaining an online-softmax accumulator — HBM traffic is O(s·d),
+VMEM residency is O(block·d) regardless of sequence length:
+
+  * logits tiles computed with ``jnp.dot(..., preferred_element_type=
+    fp32)`` → MXU at full precision for the softmax math
+  * block sizes default to 128 (MXU-native); the lane dim is head_dim
+  * causal masking per tile from broadcasted iotas, and the K-block loop
+    stops at the diagonal (dynamic fori bound), skipping the ~half of
+    tiles that are fully in the future
+  * DMA for tile t+1 issues before compute on tile t (double buffering)
+
+Backward (v1): ``jax.custom_vjp`` recomputes the reference attention
+under ``jax.vjp`` — exact gradients with O(s²) memory in backward only.
+Long-context training where that matters should shard the sequence
+(ring/Ulysses in parallel/ring.py); a Pallas backward kernel is the
+planned follow-up.
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests on
+the CPU mesh), selected automatically.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _auto_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_q, block_k, seq_k,
+                causal, scale):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32)            # [block_q, d]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    nk_total = seq_k // block_k
+    if causal:
+        # stop at the diagonal: K tiles starting past this q tile's last
+        # row contribute nothing
+        nk = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                         nk_total)
+    else:
+        nk = nk_total
+
+    def scoped(k_scr, v_scr, sem_k, sem_v):
+        def kdma(slot, kb):
+            return pltpu.make_async_copy(
+                k_hbm.at[bh, pl.ds(kb * block_k, block_k), :],
+                k_scr.at[slot], sem_k.at[slot])
+
+        def vdma(slot, kb):
+            return pltpu.make_async_copy(
+                v_hbm.at[bh, pl.ds(kb * block_k, block_k), :],
+                v_scr.at[slot], sem_v.at[slot])
+
+        kdma(0, 0).start()
+        vdma(0, 0).start()
+
+        def body(kb, carry):
+            m, l, acc = carry
+            slot = kb % 2
+
+            @pl.when(kb + 1 < nk)
+            def _prefetch():
+                kdma((kb + 1) % 2, kb + 1).start()
+                vdma((kb + 1) % 2, kb + 1).start()
+
+            kdma(slot, kb).wait()
+            vdma(slot, kb).wait()
+            k = k_scr[slot].astype(jnp.float32)
+            v = v_scr[slot].astype(jnp.float32)
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            if causal:
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + jnp.dot(
+                p, v, preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        init = (jnp.full((block_q,), _NEG_INF, jnp.float32),
+                jnp.zeros((block_q,), jnp.float32),
+                jnp.zeros((block_q, d), jnp.float32))
+        _, l, acc = jax.lax.fori_loop(0, nk, body, init)
+        o_ref[0] = (acc / jnp.clip(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        k_scr=pltpu.VMEM((2, block_k, d), k_hbm.dtype),
+        v_scr=pltpu.VMEM((2, block_k, d), v_hbm.dtype),
+        sem_k=pltpu.SemaphoreType.DMA((2,)),
+        sem_v=pltpu.SemaphoreType.DMA((2,)))
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention needs seq divisible by block sizes: "
+            f"q {sq}%{block_q}, k {sk}%{block_k}")
+    scale = d ** -0.5
+    # [b, s, h, d] → [b*h, s, d]: each program handles one (batch, head)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(_fwd_kernel, block_q=block_q,
+                               block_k=block_k, seq_k=sk, causal=causal,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            # K/V stay in HBM; the kernel DMAs block_k tiles into
+            # double-buffered VMEM scratch, so VMEM use is independent of
+            # sequence length
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret if interpret is not None else _auto_interpret(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _reference(q, k, v, causal):
+    from ..parallel.ring import full_attention
+    return full_attention(q, k, v, causal=causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                    interpret=None):
+    """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
+    global positions. Numerically equivalent to
+    parallel.ring.full_attention (exact softmax, fp32 accumulation)."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret), \
+        (q, k, v)
+
+
+def _vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
+    return vjp(g.astype(q.dtype))
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

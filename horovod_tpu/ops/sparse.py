@@ -96,13 +96,13 @@ def sparse_allreduce(slices, average=True, axis_name=None, name=None,
         # kind='replicated': these are per-process values, never the eager
         # core's stacked-leading-dim convention — without the override, an
         # nnz that happens to equal the device count would be misclassified.
-        values = mpi_ops.synchronize(mpi_ops.allgather_async(
+        values = mpi_ops.allgather(
             values, name=None if name is None else f"{name}.values",
-            kind="replicated"))
-        indices = mpi_ops.synchronize(mpi_ops.allgather_async(
+            kind="replicated")
+        indices = mpi_ops.allgather(
             slices.indices,
             name=None if name is None else f"{name}.indices",
-            kind="replicated"))
+            kind="replicated")
         # Divide by the number of eager participants (processes), not a
         # shape ratio: workers may contribute unequal nnz, and the divisor
         # must be identical on every worker for the replicas to stay in

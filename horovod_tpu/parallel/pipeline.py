@@ -24,8 +24,6 @@ with layer stacks sharded over 'pp' and embedding/head/final-norm replicated
 sum recovers the true gradient).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
