@@ -152,12 +152,35 @@ def _reference(q, k, v, causal):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
 def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
                     interpret=None):
     """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
     global positions. Numerically equivalent to
-    parallel.ring.full_attention (exact softmax, fp32 accumulation)."""
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    parallel.ring.full_attention (exact softmax, fp32 accumulation).
+
+    Sequence lengths need not divide the block sizes for causal
+    self-attention (sq == sk): inputs are end-padded to the next block
+    multiple (end-padded keys sit at positions after every real query, so
+    the causal mask discards them exactly) and the output is sliced back.
+    Other non-divisible cases would need an explicit key mask the kernel
+    doesn't carry, so they raise."""
+    sq, sk = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    pad_q, pad_k = -sq % bq, -sk % bk
+    if (pad_q or pad_k) and not (causal and sq == sk):
+        raise ValueError(
+            f"flash_attention needs seq divisible by block sizes unless "
+            f"causal self-attention: q {sq}%{bq}, k {sk}%{bk}")
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    out = _flash_core(q, k, v, causal, block_q, block_k, interpret)
+    return out[:, :sq] if pad_q else out
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -171,4 +194,4 @@ def _vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
     return vjp(g.astype(q.dtype))
 
 
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+_flash_core.defvjp(_vjp_fwd, _vjp_bwd)

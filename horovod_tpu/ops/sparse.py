@@ -93,16 +93,20 @@ def sparse_allreduce(slices, average=True, axis_name=None, name=None,
         divisor = jax.lax.axis_size(cops.resolve_axis(axis_name))
     else:
         from .. import mpi_ops
+        # Go straight to the eager core rather than through
+        # mpi_ops.allgather, which would re-run traced-context detection
+        # with axis_name=None and could route to a different (bound) mesh
+        # axis than the decision made above.
         # kind='replicated': these are per-process values, never the eager
         # core's stacked-leading-dim convention — without the override, an
         # nnz that happens to equal the device count would be misclassified.
-        values = mpi_ops.allgather(
+        values = mpi_ops.synchronize(mpi_ops.allgather_async(
             values, name=None if name is None else f"{name}.values",
-            kind="replicated")
-        indices = mpi_ops.allgather(
+            kind="replicated"))
+        indices = mpi_ops.synchronize(mpi_ops.allgather_async(
             slices.indices,
             name=None if name is None else f"{name}.indices",
-            kind="replicated")
+            kind="replicated"))
         # Divide by the number of eager participants (processes), not a
         # shape ratio: workers may contribute unequal nnz, and the divisor
         # must be identical on every worker for the replicas to stay in

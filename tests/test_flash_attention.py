@@ -59,11 +59,23 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(out1[:, :40]),
                                    np.asarray(out2[:, :40]), rtol=1e-5)
 
-    def test_rejects_indivisible(self, hvd):
+    def test_pads_indivisible_causal(self, hvd):
+        # causal self-attention end-pads to the block multiple and slices
+        # back; must match the unpadded reference exactly
+        from horovod_tpu.ops.flash_attention import flash_attention
+        from horovod_tpu.parallel.ring import full_attention
+        q, k, v = _qkv(4, s=100)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        want = full_attention(q, k, v, causal=True)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_indivisible_noncausal(self, hvd):
         from horovod_tpu.ops.flash_attention import flash_attention
         q, k, v = _qkv(4, s=100)
         with pytest.raises(ValueError, match="divisible"):
-            flash_attention(q, k, v, block_q=64, block_k=64)
+            flash_attention(q, k, v, causal=False, block_q=64, block_k=64)
 
 
 class TestFlashBackward:
