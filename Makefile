@@ -1,0 +1,35 @@
+# Developer entry points (reference: setup.py + .buildkite/gen-pipeline.sh).
+
+PY ?= python
+CPU_MESH = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+           XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test native bench examples ci clean
+
+native:
+	$(PY) setup.py build_native
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+# example smoke runs on the virtual 8-worker CPU mesh — the reference CI
+# runs its example scripts as integration tests after pytest
+# (gen-pipeline.sh:101-128)
+examples:
+	$(CPU_MESH) $(PY) examples/mnist.py --epochs 1 --steps-per-epoch 4
+	$(CPU_MESH) $(PY) examples/mnist_eager.py --steps 20
+	$(CPU_MESH) $(PY) examples/word2vec.py --steps 30 --batch-size 32
+	$(CPU_MESH) $(PY) examples/imagenet_resnet50.py --epochs 1 \
+	    --steps-per-epoch 2 --batch-size 2 --image-size 32 --val-steps 1 \
+	    --checkpoint-dir /tmp/hvd-ci-imagenet-ckpt
+	$(CPU_MESH) $(PY) examples/transformer_lm.py --size tiny --steps 3 \
+	    --dp 2 --tp 2 --sp 2 --attention ring
+	$(CPU_MESH) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+ci: native test examples
+
+clean:
+	rm -rf build dist *.egg-info /tmp/hvd-ci-imagenet-ckpt

@@ -56,7 +56,7 @@ def main():
             mutable=["batch_stats"])
         return trainer.softmax_cross_entropy(logits, lbls)
 
-    step = trainer.make_data_parallel_step(loss_fn, tx, mesh, donate=False)
+    step = trainer.make_data_parallel_step(loss_fn, tx, mesh, donate=True)
     data_sharding = jax.sharding.NamedSharding(
         mesh, P(mesh.axis_names[0]))
     images = jax.device_put(images, data_sharding)

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI pipeline (reference .buildkite/gen-pipeline.sh: pytest under mpirun,
+# then example scripts as end-to-end smoke tests). Here the "multi-rank"
+# environment is the virtual 8-device CPU mesh the test fixtures force;
+# on a TPU host the same script runs against the real chips.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- build native core"
+python setup.py build_native
+
+echo "--- unit + integration tests (8-device virtual mesh)"
+python -m pytest tests/ -q
+
+echo "--- example smoke tests"
+make examples
+
+echo "--- benchmark smoke"
+python bench.py

@@ -68,7 +68,7 @@ def main():
 
     step = trainer.make_data_parallel_step(loss_fn, tx, hvd.mesh(),
                                            compression=compression,
-                                           donate=False)
+                                           donate=True)
     sharding = NamedSharding(hvd.mesh(), P(hvd.mesh().axis_names[0]))
     images = jax.device_put(images, sharding)
     labels = jax.device_put(labels, sharding)

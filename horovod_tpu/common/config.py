@@ -78,6 +78,8 @@ class HorovodConfig:
     # Hierarchical (two-level ICI/DCN) collectives.
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Explicit ppermute ring allreduce backend (ops/operation_manager.py).
+    ring_allreduce: bool = False
     # Logging.
     log_level: str = "WARNING"
     log_timestamp: bool = False
@@ -99,6 +101,7 @@ class HorovodConfig:
             autotune_log=env_str("AUTOTUNE_LOG", "") or "",
             hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER", False),
+            ring_allreduce=env_bool("RING_ALLREDUCE", False),
             log_level=env_str("LOG_LEVEL", "WARNING") or "WARNING",
             log_timestamp=env_bool("LOG_TIMESTAMP", False),
         )

@@ -48,8 +48,14 @@ def _bound_axis_names():
 
 
 def resolve_axis(axis_name=None):
-    """Pick the collective axis: explicit > traced mesh axis > None (eager)."""
+    """Pick the collective axis: explicit > traced mesh axis > None (eager).
+    ``axis_name`` may be a tuple of axes (a reduction spanning a whole
+    hierarchy, e.g. ("slices", "chips")) — resolved iff every member is
+    bound."""
     bound = _bound_axis_names()
+    if isinstance(axis_name, (tuple, list)):
+        return tuple(axis_name) if all(a in bound for a in axis_name) \
+            else None
     if axis_name is not None:
         return axis_name if axis_name in bound else None
     if not bound:
@@ -82,7 +88,10 @@ def allreduce_traced(tensor, average=True, axis_name=None, op=None,
     op = op or (AVERAGE if average else SUM)
     compressed, ctx = compression.compress(tensor)
     if op in (SUM, AVERAGE):
-        reduced = lax.psum(compressed, axis)
+        # backend dispatch (hierarchical/ring/xla) — reference
+        # OperationManager priority selection, operation_manager.cc:67-80
+        from .operation_manager import get_operation_manager
+        reduced = get_operation_manager().allreduce(compressed, axis)
     elif op == MIN:
         reduced = lax.pmin(compressed, axis)
     elif op == MAX:
@@ -91,8 +100,17 @@ def allreduce_traced(tensor, average=True, axis_name=None, op=None,
         raise ValueError(f"Unknown reduction op: {op}")
     reduced = compression.decompress(reduced, ctx)
     if op == AVERAGE:
-        reduced = reduced / lax.axis_size(axis)
+        reduced = reduced / _axis_total_size(axis)
     return reduced
+
+
+def _axis_total_size(axis):
+    if isinstance(axis, (tuple, list)):
+        size = 1
+        for a in axis:
+            size *= lax.axis_size(a)
+        return size
+    return lax.axis_size(axis)
 
 
 def grouped_allreduce_traced(tensors, average=True, axis_name=None,
@@ -113,13 +131,15 @@ def grouped_allreduce_traced(tensors, average=True, axis_name=None,
         c, ctx = compression.compress(leaf)
         compressed.append(c)
         ctxs.append(ctx)
+    from .operation_manager import get_operation_manager
+    om = get_operation_manager()
     summed = fusion_mod.fused_map(
-        lambda flat: lax.psum(flat, axis), compressed, fusion_threshold)
+        lambda flat: om.allreduce(flat, axis), compressed, fusion_threshold)
     out = []
     for s, ctx in zip(summed, ctxs):
         s = compression.decompress(s, ctx)
         if average:
-            s = s / lax.axis_size(axis)
+            s = s / _axis_total_size(axis)
         out.append(s)
     return jax.tree_util.tree_unflatten(treedef, out)
 

@@ -1,0 +1,125 @@
+"""Priority-ordered collective-backend dispatch.
+
+TPU-native OperationManager (reference
+horovod/common/ops/operation_manager.{h,cc}: CreateOperationManager builds
+priority-ordered op lists, and the first op whose ``Enabled()`` returns
+true executes — operations.cc:126-159, operation_manager.cc:32-80). The
+reference's list is NCCL-hierarchical > NCCL > CUDA-aware-MPI > DDL > MPI;
+ours is:
+
+  1. ``hierarchical`` — two-level ICI/DCN reduction
+     (parallel/hierarchical.py, the NCCLHierarchicalAllreduce analogue).
+     Enabled when ``HOROVOD_HIERARCHICAL_ALLREDUCE=1`` and the current
+     traced context binds both hierarchy axes.
+  2. ``ring`` — explicit ppermute ring reduce-scatter/all-gather
+     (parallel/ring_collectives.py, the literal Horovod ring algorithm).
+     Enabled by ``HOROVOD_RING_ALLREDUCE=1``; useful where the neighbour
+     schedule should be explicit (DCN rings, bandwidth experiments).
+  3. ``xla`` — ``lax.psum``: XLA picks the topology-optimal algorithm.
+     Always enabled (the MPIAllreduce-style fallback).
+
+Like the reference, selection is per-call: ``Enabled()`` sees the current
+context (bound axes), so one program can take the hierarchical path inside
+a two-axis shard_map and the XLA path elsewhere.
+"""
+
+import math
+
+from jax import lax
+
+from ..common import state as state_mod
+
+HIER_FAST_AXIS = "chips"
+HIER_SLOW_AXIS = "slices"
+
+
+class CollectiveBackend:
+    """One entry in the priority list (reference HorovodOp +
+    Enabled() predicate, ops/collective_operations.h:33-49)."""
+
+    name = "base"
+
+    def enabled(self, axis, bound_axes, config):
+        raise NotImplementedError
+
+    def allreduce(self, tensor, axis, average=False):
+        raise NotImplementedError
+
+
+class HierarchicalBackend(CollectiveBackend):
+    name = "hierarchical"
+
+    def enabled(self, axis, bound_axes, config):
+        if config is None or not config.hierarchical_allreduce:
+            return False
+        if HIER_FAST_AXIS not in bound_axes or HIER_SLOW_AXIS not in bound_axes:
+            return False
+        # only take over reductions that span the whole hierarchy — a
+        # reduction over a single named axis keeps its exact semantics
+        return (isinstance(axis, (tuple, list)) and
+                set(axis) == {HIER_FAST_AXIS, HIER_SLOW_AXIS})
+
+    def allreduce(self, tensor, axis, average=False):
+        from ..parallel import hierarchical
+        return hierarchical.hierarchical_allreduce(
+            tensor, fast_axis=HIER_FAST_AXIS, slow_axis=HIER_SLOW_AXIS,
+            average=average)
+
+
+class RingBackend(CollectiveBackend):
+    name = "ring"
+
+    def enabled(self, axis, bound_axes, config):
+        if config is None or not config.ring_allreduce:
+            return False
+        # the explicit ring runs over exactly one named axis
+        return isinstance(axis, str) and axis in bound_axes
+
+    def allreduce(self, tensor, axis, average=False):
+        from ..parallel import ring_collectives
+        return ring_collectives.ring_all_reduce(tensor, axis,
+                                                average=average)
+
+
+class XlaBackend(CollectiveBackend):
+    name = "xla"
+
+    def enabled(self, axis, bound_axes, config):
+        return True
+
+    def allreduce(self, tensor, axis, average=False):
+        reduced = lax.psum(tensor, axis)
+        if average:
+            size = (lax.axis_size(axis) if isinstance(axis, str) else
+                    math.prod(lax.axis_size(a) for a in axis))
+            reduced = reduced / size
+        return reduced
+
+
+class OperationManager:
+    """First-enabled-wins dispatch (reference
+    operation_manager.cc:67-80)."""
+
+    def __init__(self, backends=None):
+        self.backends = backends or [HierarchicalBackend(), RingBackend(),
+                                     XlaBackend()]
+
+    def _select(self, axis, bound_axes, config):
+        for b in self.backends:
+            if b.enabled(axis, bound_axes, config):
+                return b
+        raise RuntimeError("No collective backend enabled")  # unreachable
+
+    def allreduce(self, tensor, axis, average=False):
+        from .collective_ops import _bound_axis_names
+        config = (state_mod.global_state().config
+                  if state_mod.is_initialized() else None)
+        backend = self._select(axis, _bound_axis_names(), config)
+        return backend.allreduce(tensor, axis, average=average)
+
+
+_manager = OperationManager()
+
+
+def get_operation_manager():
+    return _manager
