@@ -11,6 +11,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -20,7 +21,7 @@ import numpy as np
 BASELINE_IMG_PER_SEC_PER_WORKER = 219.0  # P100 ResNet-50, reference baseline
 
 
-def main():
+def _build(batch_per_chip, image_size, n_chips, mesh):
     import jax
     import jax.numpy as jnp
     import optax
@@ -30,15 +31,7 @@ def main():
     from horovod_tpu import trainer
     from horovod_tpu.models import resnet
 
-    hvd.init()
-    n_chips = hvd.size()
-    mesh = hvd.mesh()
-
-    platform = jax.devices()[0].platform
-    batch_per_chip = 128 if platform == "tpu" else 4
-    image_size = 224 if platform == "tpu" else 64
     batch = batch_per_chip * n_chips
-
     model = resnet.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     images = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
@@ -57,17 +50,57 @@ def main():
         return trainer.softmax_cross_entropy(logits, lbls)
 
     step = trainer.make_data_parallel_step(loss_fn, tx, mesh, donate=True)
-    data_sharding = jax.sharding.NamedSharding(
-        mesh, P(mesh.axis_names[0]))
+    data_sharding = jax.sharding.NamedSharding(mesh, P(mesh.axis_names[0]))
     images = jax.device_put(images, data_sharding)
     labels = jax.device_put(labels, data_sharding)
+    return step, params, opt_state, images, labels
 
-    # warmup (reference: 10 warmup batches)
-    for _ in range(3):
+
+def main():
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n_chips = hvd.size()
+    mesh = hvd.mesh()
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    image_size = 224 if on_tpu else 64
+    # Largest per-chip batch that compiles+runs wins MXU utilization; fall
+    # back on OOM (RESOURCE_EXHAUSTED) so the bench always completes.
+    env_batch = os.environ.get("HVD_BENCH_BATCH")
+    candidates = ([int(env_batch)] if env_batch else
+                  [256, 128, 64] if on_tpu else [4])
+
+    step = params = opt_state = images = labels = None
+    batch_per_chip = candidates[-1]
+    for cand in candidates:
+        try:
+            step, params, opt_state, images, labels = _build(
+                cand, image_size, n_chips, mesh)
+            params, opt_state, loss = step(params, opt_state,
+                                           (images, labels))
+            jax.block_until_ready(loss)
+            batch_per_chip = cand
+            break
+        except Exception as e:  # noqa: BLE001 — OOM fallback
+            if cand == candidates[-1] or "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            # release the failed candidate's arrays/executable before
+            # building the smaller one, or the retry inherits its memory
+            step = params = opt_state = images = labels = None
+            jax.clear_caches()
+            print(f"batch {cand}/chip OOM, trying smaller", file=sys.stderr)
+    batch = batch_per_chip * n_chips
+
+    # warmup (reference: 10 warmup batches; first step above compiled)
+    for _ in range(3 if on_tpu else 2):
         params, opt_state, loss = step(params, opt_state, (images, labels))
     jax.block_until_ready(loss)
 
-    iters, inner = (10, 10) if platform == "tpu" else (3, 3)
+    iters, inner = (10, 10) if on_tpu else (3, 3)
     rates = []
     for _ in range(iters):
         t0 = time.perf_counter()

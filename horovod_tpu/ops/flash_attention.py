@@ -1,4 +1,4 @@
-"""Fused (flash) attention as a Pallas TPU kernel.
+"""Fused (flash) attention as a Pallas TPU kernel — forward and backward.
 
 The hot op of the flagship transformer. XLA's default attention
 materializes the [s, s] logits in HBM; this kernel keeps K/V in HBM and
@@ -14,11 +14,14 @@ VMEM residency is O(block·d) regardless of sequence length:
     tiles that are fully in the future
   * DMA for tile t+1 issues before compute on tile t (double buffering)
 
-Backward (v1): ``jax.custom_vjp`` recomputes the reference attention
-under ``jax.vjp`` — exact gradients with O(s²) memory in backward only.
-Long-context training where that matters should shard the sequence
-(ring/Ulysses in parallel/ring.py); a Pallas backward kernel is the
-planned follow-up.
+Backward is the standard flash-attention recomputation scheme, also as
+Pallas kernels: the forward additionally writes the per-row log-sum-exp
+(lse), so the backward re-materializes each probability tile as
+``exp(s − lse)`` without ever storing the [s, s] matrix — one kernel
+accumulates dQ (gridded over Q blocks, streaming K/V), a second
+accumulates dK/dV (gridded over K blocks, streaming Q/dO/lse/delta, and
+starting at the diagonal for causal). Memory is O(s·d) in backward too,
+which is what makes long-context training with this kernel viable.
 
 On non-TPU backends the kernel runs in Pallas interpret mode (tests on
 the CPU mesh), selected automatically.
@@ -38,8 +41,31 @@ def _auto_interpret():
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_q, block_k, seq_k,
-                causal, scale):
+def _stream(hbm, bh, block, scr, sem):
+    """Double-buffered HBM→VMEM tile stream: returns ``dma(slot, i)`` for
+    tile i of ``hbm[bh]`` (rows i·block .. i·block+block) into scratch slot
+    ``slot``. Works for [bh, s, d] matrices and [bh, s] vectors."""
+    def dma(slot, i):
+        if len(hbm.shape) == 3:
+            src = hbm.at[bh, pl.ds(i * block, block), :]
+        else:
+            src = hbm.at[bh, pl.ds(i * block, block)]
+        return pltpu.make_async_copy(src, scr.at[slot], sem.at[slot])
+    return dma
+
+
+def _start_all(streams, slot, i):
+    for s in streams:
+        s(slot, i).start()
+
+
+def _wait_all(streams, slot, i):
+    for s in streams:
+        s(slot, i).wait()
+
+
+def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
+                seq_k, causal, scale):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
@@ -57,18 +83,9 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_q, block_k, seq_k,
         nk = nk_total
 
     def scoped(k_scr, v_scr, sem_k, sem_v):
-        def kdma(slot, kb):
-            return pltpu.make_async_copy(
-                k_hbm.at[bh, pl.ds(kb * block_k, block_k), :],
-                k_scr.at[slot], sem_k.at[slot])
-
-        def vdma(slot, kb):
-            return pltpu.make_async_copy(
-                v_hbm.at[bh, pl.ds(kb * block_k, block_k), :],
-                v_scr.at[slot], sem_v.at[slot])
-
-        kdma(0, 0).start()
-        vdma(0, 0).start()
+        streams = [_stream(k_hbm, bh, block_k, k_scr, sem_k),
+                   _stream(v_hbm, bh, block_k, v_scr, sem_v)]
+        _start_all(streams, 0, 0)
 
         def body(kb, carry):
             m, l, acc = carry
@@ -76,11 +93,9 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_q, block_k, seq_k,
 
             @pl.when(kb + 1 < nk)
             def _prefetch():
-                kdma((kb + 1) % 2, kb + 1).start()
-                vdma((kb + 1) % 2, kb + 1).start()
+                _start_all(streams, (kb + 1) % 2, kb + 1)
 
-            kdma(slot, kb).wait()
-            vdma(slot, kb).wait()
+            _wait_all(streams, slot, kb)
             k = k_scr[slot].astype(jnp.float32)
             v = v_scr[slot].astype(jnp.float32)
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
@@ -99,8 +114,11 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, *, block_q, block_k, seq_k,
         init = (jnp.full((block_q,), _NEG_INF, jnp.float32),
                 jnp.zeros((block_q,), jnp.float32),
                 jnp.zeros((block_q, d), jnp.float32))
-        _, l, acc = jax.lax.fori_loop(0, nk, body, init)
-        o_ref[0] = (acc / jnp.clip(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        m, l, acc = jax.lax.fori_loop(0, nk, body, init)
+        l = jnp.clip(l, 1e-30)
+        o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+        # per-row log-sum-exp: the backward's softmax residual
+        lse_ref[0] = m + jnp.log(l)
 
     pl.run_scoped(
         scoped,
@@ -128,7 +146,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, seq_k=sk, causal=causal,
                                scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -139,28 +157,226 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
         interpret=interpret if interpret is not None else _auto_interpret(),
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
 
 
-def _reference(q, k, v, causal):
-    from ..parallel.ring import full_attention
-    return full_attention(q, k, v, causal=causal)
+def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
+               block_q, block_k, seq_k, causal, scale):
+    """dQ, gridded like the forward: one (batch·head, q-block) per program,
+    K/V streamed from HBM. ds = p ∘ (dP − delta); dq = scale · ds @ K."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    nk_total = seq_k // block_k
+    if causal:
+        nk = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                         nk_total)
+    else:
+        nk = nk_total
+
+    def scoped(k_scr, v_scr, sem_k, sem_v):
+        streams = [_stream(k_hbm, bh, block_k, k_scr, sem_k),
+                   _stream(v_hbm, bh, block_k, v_scr, sem_v)]
+        _start_all(streams, 0, 0)
+
+        def body(kb, dq):
+            slot = kb % 2
+
+            @pl.when(kb + 1 < nk)
+            def _prefetch():
+                _start_all(streams, (kb + 1) % 2, kb + 1)
+
+            _wait_all(streams, slot, kb)
+            k = k_scr[slot].astype(jnp.float32)
+            v = v_scr[slot].astype(jnp.float32)
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            if causal:
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None]) * scale
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, nk, body,
+                               jnp.zeros((block_q, d), jnp.float32))
+        dq_ref[0] = dq.astype(dq_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        k_scr=pltpu.VMEM((2, block_k, d), k_hbm.dtype),
+        v_scr=pltpu.VMEM((2, block_k, d), v_hbm.dtype),
+        sem_k=pltpu.SemaphoreType.DMA((2,)),
+        sem_v=pltpu.SemaphoreType.DMA((2,)))
+
+
+def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
+                dv_ref, *, block_q, block_k, seq_q, causal, scale):
+    """dK/dV, gridded over (batch·head, k-block), Q/dO/lse/delta streamed
+    from HBM; for causal the Q loop starts at the diagonal block."""
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    d = k_ref.shape[-1]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    nq_total = seq_q // block_q
+    if causal:
+        # first q block whose last row can see this k block's first row
+        qb_start = (ki * block_k) // block_q
+    else:
+        qb_start = 0
+
+    def scoped(q_scr, do_scr, lse_scr, delta_scr, sem_q, sem_do, sem_l,
+               sem_dl):
+        streams = [_stream(q_hbm, bh, block_q, q_scr, sem_q),
+                   _stream(do_hbm, bh, block_q, do_scr, sem_do),
+                   _stream(lse_hbm, bh, block_q, lse_scr, sem_l),
+                   _stream(delta_hbm, bh, block_q, delta_scr, sem_dl)]
+        _start_all(streams, qb_start % 2, qb_start)
+
+        def body(qb, carry):
+            dk, dv = carry
+            slot = qb % 2
+
+            @pl.when(qb + 1 < nq_total)
+            def _prefetch():
+                _start_all(streams, (qb + 1) % 2, qb + 1)
+
+            _wait_all(streams, slot, qb)
+            q = q_scr[slot].astype(jnp.float32)
+            do = do_scr[slot].astype(jnp.float32)
+            lse = lse_scr[slot]
+            delta = delta_scr[slot]
+
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            if causal:
+                q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])                  # [bq, bk]
+            dv = dv + jnp.dot(p.T, do,
+                              preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None]) * scale
+            dk = dk + jnp.dot(ds.T, q,
+                              preferred_element_type=jnp.float32)
+            return dk, dv
+
+        init = (jnp.zeros((block_k, d), jnp.float32),
+                jnp.zeros((block_k, d), jnp.float32))
+        dk, dv = jax.lax.fori_loop(qb_start, nq_total, body, init)
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        q_scr=pltpu.VMEM((2, block_q, d), q_hbm.dtype),
+        do_scr=pltpu.VMEM((2, block_q, d), do_hbm.dtype),
+        lse_scr=pltpu.VMEM((2, block_q), jnp.float32),
+        delta_scr=pltpu.VMEM((2, block_q), jnp.float32),
+        sem_q=pltpu.SemaphoreType.DMA((2,)),
+        sem_do=pltpu.SemaphoreType.DMA((2,)),
+        sem_l=pltpu.SemaphoreType.DMA((2,)),
+        sem_dl=pltpu.SemaphoreType.DMA((2,)))
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    scale = d ** -0.5
+    interpret = interpret if interpret is not None else _auto_interpret()
+
+    def flat(t, s):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qf, kf, vf = flat(q, sq), flat(k, sk), flat(v, sk)
+    dof, of = flat(g, sq), flat(out, sq)
+    # delta_i = Σ_d dO_i ⊙ O_i — the dP correction term; elementwise, XLA
+    # fuses it, no kernel needed
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
+                          seq_k=sk, causal=causal, scale=scale),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, dof, lse, delta, kf, vf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
+                          seq_q=sq, causal=causal, scale=scale),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, dof, lse, delta)
+
+    def unflat(t, s):
+        return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_core(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
                     interpret=None):
     """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
     global positions. Numerically equivalent to
-    parallel.ring.full_attention (exact softmax, fp32 accumulation).
+    parallel.ring.full_attention (exact softmax, fp32 accumulation), in
+    forward and backward, with O(s·d) memory in both.
 
     Sequence lengths need not divide the block sizes for causal
     self-attention (sq == sk): inputs are end-padded to the next block
@@ -184,14 +400,14 @@ def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret), \
-        (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
-    return vjp(g.astype(q.dtype))
+    q, k, v, out, lse = residuals
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+                      interpret)
 
 
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)

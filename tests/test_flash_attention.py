@@ -99,6 +99,59 @@ class TestFlashBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("bq,bk", [(32, 64), (64, 32)])
+    def test_grad_asymmetric_blocks(self, hvd, bq, bk):
+        """Unequal block_q/block_k exercises the diagonal start/stop index
+        math (qb_start, nk) off its degenerate equal-block form."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import flash_attention
+        from horovod_tpu.parallel.ring import full_attention
+        q, k, v = _qkv(11, s=128)
+
+        g_flash = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(full_attention(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grad_non_causal(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import flash_attention
+        from horovod_tpu.parallel.ring import full_attention
+        q, k, v = _qkv(3, s=64)
+
+        g_flash = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=False, block_q=32, block_k=32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(full_attention(
+            q, k, v, causal=False) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grad_padded_causal(self, hvd):
+        """Backward through the end-padding path (seq 100, block 64):
+        padded rows/keys must contribute exactly nothing."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import flash_attention
+        from horovod_tpu.parallel.ring import full_attention
+        q, k, v = _qkv(7, s=100)
+
+        g_flash = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=64) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(full_attention(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
 
 class TestTransformerFlash:
     def test_flash_model_matches_full(self, hvd):
