@@ -82,7 +82,7 @@ def main():
                 cand, image_size, n_chips, mesh)
             params, opt_state, loss = step(params, opt_state,
                                            (images, labels))
-            jax.block_until_ready(loss)
+            float(loss)  # scalar transfer: a sync barrier on every backend
             batch_per_chip = cand
             break
         except Exception as e:  # noqa: BLE001 — OOM fallback
@@ -98,7 +98,7 @@ def main():
     # warmup (reference: 10 warmup batches; first step above compiled)
     for _ in range(3 if on_tpu else 2):
         params, opt_state, loss = step(params, opt_state, (images, labels))
-    jax.block_until_ready(loss)
+    float(loss)  # scalar transfer: a sync barrier on every backend
 
     iters, inner = (10, 10) if on_tpu else (3, 3)
     rates = []
@@ -107,7 +107,7 @@ def main():
         for _ in range(inner):
             params, opt_state, loss = step(params, opt_state,
                                            (images, labels))
-        jax.block_until_ready(loss)
+        float(loss)  # scalar transfer: a sync barrier on every backend
         dt = time.perf_counter() - t0
         rates.append(batch * inner / dt)
 

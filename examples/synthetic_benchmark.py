@@ -79,7 +79,7 @@ def main():
 
     for _ in range(args.num_warmup_batches):
         params, opt_state, loss = step(params, opt_state, (images, labels))
-    jax.block_until_ready(loss)
+    float(loss)  # scalar transfer: a sync barrier on every backend
 
     img_secs = []
     for i in range(args.num_iters):
@@ -87,7 +87,7 @@ def main():
         for _ in range(args.num_batches_per_iter):
             params, opt_state, loss = step(params, opt_state,
                                            (images, labels))
-        jax.block_until_ready(loss)
+        float(loss)  # scalar transfer: a sync barrier on every backend
         rate = batch * args.num_batches_per_iter / (time.perf_counter() - t0)
         img_secs.append(rate / world)
         if hvd.process_rank() == 0:

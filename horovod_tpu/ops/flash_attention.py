@@ -41,15 +41,16 @@ def _auto_interpret():
     return jax.default_backend() != "tpu"
 
 
-def _stream(hbm, bh, block, scr, sem):
+def _stream(hbm, bh, block, scr, sem, seq_axis=1):
     """Double-buffered HBM→VMEM tile stream: returns ``dma(slot, i)`` for
-    tile i of ``hbm[bh]`` (rows i·block .. i·block+block) into scratch slot
-    ``slot``. Works for [bh, s, d] matrices and [bh, s] vectors."""
+    tile i of ``hbm[bh]`` (``block`` rows along ``seq_axis``) into scratch
+    slot ``slot``. seq_axis=1 for [bh, s, d] matrices, seq_axis=2 for the
+    sublane-replicated [bh, 8, s] row-statistic layout."""
     def dma(slot, i):
-        if len(hbm.shape) == 3:
-            src = hbm.at[bh, pl.ds(i * block, block), :]
+        if seq_axis == 2:
+            src = hbm.at[bh, :, pl.ds(i * block, block)]
         else:
-            src = hbm.at[bh, pl.ds(i * block, block)]
+            src = hbm.at[bh, pl.ds(i * block, block), :]
         return pltpu.make_async_copy(src, scr.at[slot], sem.at[slot])
     return dma
 
@@ -117,8 +118,10 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
         m, l, acc = jax.lax.fori_loop(0, nk, body, init)
         l = jnp.clip(l, 1e-30)
         o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-        # per-row log-sum-exp: the backward's softmax residual
-        lse_ref[0] = m + jnp.log(l)
+        # per-row log-sum-exp (the backward's softmax residual), replicated
+        # over an 8-row sublane dim to satisfy the TPU (8, 128) tile rule
+        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[None, :],
+                                      (8, m.shape[0]))
 
     pl.run_scoped(
         scoped,
@@ -128,7 +131,7 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
         sem_v=pltpu.SemaphoreType.DMA((2,)))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -137,7 +140,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         raise ValueError(
             f"flash_attention needs seq divisible by block sizes: "
             f"q {sq}%{block_q}, k {sk}%{block_k}")
-    scale = d ** -0.5
+    if scale is None:
+        scale = d ** -0.5
     # [b, s, h, d] → [b*h, s, d]: each program handles one (batch, head)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -159,11 +163,11 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 8, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 8, sq), jnp.float32),
         ],
         interpret=interpret if interpret is not None else _auto_interpret(),
     )(qf, kf, vf)
@@ -179,8 +183,8 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
     d = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]        # row 0 of the 8-way replicated sublane dim
+    delta = delta_ref[0, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
@@ -251,8 +255,10 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
                sem_dl):
         streams = [_stream(q_hbm, bh, block_q, q_scr, sem_q),
                    _stream(do_hbm, bh, block_q, do_scr, sem_do),
-                   _stream(lse_hbm, bh, block_q, lse_scr, sem_l),
-                   _stream(delta_hbm, bh, block_q, delta_scr, sem_dl)]
+                   _stream(lse_hbm, bh, block_q, lse_scr, sem_l,
+                           seq_axis=2),
+                   _stream(delta_hbm, bh, block_q, delta_scr, sem_dl,
+                           seq_axis=2)]
         _start_all(streams, qb_start % 2, qb_start)
 
         def body(qb, carry):
@@ -266,8 +272,8 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
             _wait_all(streams, slot, qb)
             q = q_scr[slot].astype(jnp.float32)
             do = do_scr[slot].astype(jnp.float32)
-            lse = lse_scr[slot]
-            delta = delta_scr[slot]
+            lse = lse_scr[slot, 0]     # row 0 of the replicated sublanes
+            delta = delta_scr[slot, 0]
 
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
             if causal:
@@ -293,20 +299,22 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
         scoped,
         q_scr=pltpu.VMEM((2, block_q, d), q_hbm.dtype),
         do_scr=pltpu.VMEM((2, block_q, d), do_hbm.dtype),
-        lse_scr=pltpu.VMEM((2, block_q), jnp.float32),
-        delta_scr=pltpu.VMEM((2, block_q), jnp.float32),
+        lse_scr=pltpu.VMEM((2, 8, block_q), jnp.float32),
+        delta_scr=pltpu.VMEM((2, 8, block_q), jnp.float32),
         sem_q=pltpu.SemaphoreType.DMA((2,)),
         sem_do=pltpu.SemaphoreType.DMA((2,)),
         sem_l=pltpu.SemaphoreType.DMA((2,)),
         sem_dl=pltpu.SemaphoreType.DMA((2,)))
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
+               scale=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    scale = d ** -0.5
+    if scale is None:
+        scale = d ** -0.5
     interpret = interpret if interpret is not None else _auto_interpret()
 
     def flat(t, s):
@@ -315,9 +323,10 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     qf, kf, vf = flat(q, sq), flat(k, sk), flat(v, sk)
     dof, of = flat(g, sq), flat(out, sq)
     # delta_i = Σ_d dO_i ⊙ O_i — the dP correction term; elementwise, XLA
-    # fuses it, no kernel needed
+    # fuses it, no kernel needed. Same sublane-replicated layout as lse.
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, sq))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
@@ -326,8 +335,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 8, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 8, block_q), lambda i, j: (i, 0, j)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -365,26 +374,33 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret, scale):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                        scale=scale)
     return out
 
 
-def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+def flash_attention(q, k, v, causal=True, block_q=256, block_k=256,
                     interpret=None):
     """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
     global positions. Numerically equivalent to
     parallel.ring.full_attention (exact softmax, fp32 accumulation), in
-    forward and backward, with O(s·d) memory in both.
+    forward and backward, with O(s·d) memory in both. Default 256-blocks
+    measured fastest on v5e (seq 4096: fwd 12.4 ms, fwd+bwd 18.9 ms vs
+    14.4/32.5 at 128).
 
     Sequence lengths need not divide the block sizes for causal
     self-attention (sq == sk): inputs are end-padded to the next block
     multiple (end-padded keys sit at positions after every real query, so
     the causal mask discards them exactly) and the output is sliced back.
     Other non-divisible cases would need an explicit key mask the kernel
-    doesn't carry, so they raise."""
+    doesn't carry, so they raise. On real TPU, head_dim is zero-padded to
+    the 128-lane tile (softmax scale keeps the true head_dim; zero columns
+    drop out of every dot product)."""
     sq, sk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
+    scale = d ** -0.5
     bq, bk = min(block_q, sq), min(block_k, sk)
     pad_q, pad_k = -sq % bq, -sk % bk
     if (pad_q or pad_k) and not (causal and sq == sk):
@@ -395,19 +411,28 @@ def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
-    out = _flash_core(q, k, v, causal, block_q, block_k, interpret)
+    interpret_eff = interpret if interpret is not None else _auto_interpret()
+    pad_d = 0 if interpret_eff else -d % 128
+    if pad_d:
+        pads = ((0, 0), (0, 0), (0, 0), (0, pad_d))
+        q, k, v = jnp.pad(q, pads), jnp.pad(k, pads), jnp.pad(v, pads)
+    out = _flash_core(q, k, v, causal, block_q, block_k, interpret_eff,
+                      scale)
+    if pad_d:
+        out = out[..., :d]
     return out[:, :sq] if pad_q else out
 
 
-def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret, scale):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                          scale=scale)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _vjp_bwd(causal, block_q, block_k, interpret, scale, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
-                      interpret)
+                      interpret, scale=scale)
 
 
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)
