@@ -40,7 +40,7 @@ def _build(batch_per_chip, image_size, n_chips, mesh):
     params, batch_stats = variables["params"], variables["batch_stats"]
 
     tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
-    opt_state = tx.init(params)
+    opt_state = trainer.init_opt_state(tx, params, mesh)
 
     def loss_fn(p, batch_data):
         imgs, lbls = batch_data

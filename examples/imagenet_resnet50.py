@@ -119,7 +119,7 @@ def main():
             learning_rate=args.base_lr * world, momentum=args.momentum),
         compression=compression,
         backward_passes_per_step=args.batches_per_allreduce)
-    opt_state = tx.init(params)
+    opt_state = trainer.init_opt_state(tx, params, hvd.mesh())
 
     start_epoch = 0
     if checkpoint.exists(args.checkpoint_dir):
@@ -142,8 +142,13 @@ def main():
                            for w in jax.tree_util.tree_leaves(p))
             return ce + args.wd * l2, mut["batch_stats"]
 
+        # grads must be per-worker when they reach the DistributedOptimizer
+        # (replicated params would make autodiff pre-sum them — see
+        # hvd.ensure_varying)
+        vparams = jax.tree_util.tree_map(
+            lambda p: hvd.ensure_varying(p, axis), params)
         (loss, new_bs), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            loss_fn, has_aux=True)(vparams)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         # keep BN statistics identical across replicas (the reference

@@ -81,7 +81,7 @@ def main():
     tx = hvd.DistributedOptimizer(
         optax.sgd(args.lr * world, momentum=args.momentum),
         backward_passes_per_step=args.batches_per_allreduce)
-    opt_state = tx.init(params)
+    opt_state = trainer.init_opt_state(tx, params, hvd.mesh())
 
     start_epoch = 0
     if checkpoint.exists(args.checkpoint_dir):

@@ -57,7 +57,7 @@ def main():
                    else hvd.Compression.none)
     tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
                                   compression=compression)
-    opt_state = tx.init(params)
+    opt_state = trainer.init_opt_state(tx, params, hvd.mesh())
 
     def loss_fn(p, b):
         imgs, lbls = b

@@ -110,9 +110,10 @@ def main():
     loss_fn = tr.lm_loss_fn(model)
     specs = tr.param_specs(params)
     step, param_shardings, batch_sharding = trainer.make_gspmd_step(
-        loss_fn, tx, mesh, specs, tr.batch_spec(sp=args.sp > 1))
+        loss_fn, tx, mesh, specs, tr.batch_spec(sp=args.sp > 1),
+        params=params)
     params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
-    opt_state = tx.init(params)
+    opt_state = trainer.init_opt_state(tx, params, mesh, specs)
 
     start_step = 0
     if args.checkpoint_dir and checkpoint.exists(args.checkpoint_dir):

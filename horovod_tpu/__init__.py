@@ -32,6 +32,7 @@ from .mpi_ops import (  # noqa: F401
     broadcast, broadcast_, broadcast_async, broadcast_async_,
     reducescatter, alltoall,
     poll, synchronize)
+from .ops.collective_ops import ensure_varying  # noqa: F401
 from .ops.compression import Compression  # noqa: F401
 from .ops.sparse import (  # noqa: F401
     IndexedSlices, sparse_allreduce)

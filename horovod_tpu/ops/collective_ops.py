@@ -67,6 +67,25 @@ def resolve_axis(axis_name=None):
     return bound[0]
 
 
+def ensure_varying(x, axis_names):
+    """Return ``x`` typed device-varying over ``axis_names`` (no-op for
+    axes it already varies over).
+
+    Differentiating w.r.t. an UNvarying value inside shard_map makes
+    autodiff psum the cotangent itself — grads arrive pre-summed and a
+    subsequent explicit allreduce silently keeps the sum (psum of identical
+    values ÷ size). Casting the differentiated inputs varying first keeps
+    grads per-worker, so the framework's fused collective is the one true
+    reduction."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    vma = jax.typeof(x).vma
+    missing = tuple(a for a in axis_names if a not in vma)
+    if not missing:
+        return x
+    return lax.pcast(x, missing, to="varying")
+
+
 def in_traced_context(axis_name=None):
     return resolve_axis(axis_name) is not None
 

@@ -401,7 +401,17 @@ def flash_attention(q, k, v, causal=True, block_q=256, block_k=256,
     sq, sk = q.shape[1], k.shape[1]
     d = q.shape[-1]
     scale = d ** -0.5
-    bq, bk = min(block_q, sq), min(block_k, sk)
+
+    def fit_block(block, s):
+        # largest block ≤ requested that divides the sequence, halving no
+        # further than 128 (the MXU-friendly floor) — a 256 default must
+        # not reject lengths like 384 that 128-blocks handled
+        b = min(block, s)
+        while b > 128 and s % b:
+            b //= 2
+        return b
+
+    bq, bk = fit_block(block_q, sq), fit_block(block_k, sk)
     pad_q, pad_k = -sq % bq, -sk % bk
     if (pad_q or pad_k) and not (causal and sq == sk):
         raise ValueError(
@@ -416,8 +426,7 @@ def flash_attention(q, k, v, causal=True, block_q=256, block_k=256,
     if pad_d:
         pads = ((0, 0), (0, 0), (0, 0), (0, pad_d))
         q, k, v = jnp.pad(q, pads), jnp.pad(k, pads), jnp.pad(v, pads)
-    out = _flash_core(q, k, v, causal, block_q, block_k, interpret_eff,
-                      scale)
+    out = _flash_core(q, k, v, causal, bq, bk, interpret_eff, scale)
     if pad_d:
         out = out[..., :d]
     return out[:, :sq] if pad_q else out

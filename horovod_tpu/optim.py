@@ -145,6 +145,15 @@ def distributed_grad(fun, argnums=0, compression=Compression.none,
     grad_fn = jax.grad(fun, argnums=argnums, has_aux=has_aux)
 
     def wrapped(*args, **kwargs):
+        if cops.in_traced_context(axis_name):
+            # see ensure_varying: replicated inputs would make autodiff
+            # pre-sum the grads, and the allreduce below would keep the sum
+            axis = cops.resolve_axis(axis_name)
+            nums = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+            args = tuple(jax.tree_util.tree_map(
+                lambda x: cops.ensure_varying(x, axis), a)
+                         if i in nums else a
+                         for i, a in enumerate(args))
         if has_aux:
             grads, aux = grad_fn(*args, **kwargs)
             return allreduce_gradients(

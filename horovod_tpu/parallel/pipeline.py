@@ -53,11 +53,11 @@ def gpipe(stage_fn, microbatches, axis_name="pp"):
 
     # the carry becomes device-varying over pp after the first ppermute /
     # stage-masked write; mark it varying from the start so the scan's
-    # carry type is stable
-    state = lax.pcast(jnp.zeros_like(microbatches[0]), (axis_name,),
-                      to="varying")
-    outputs = lax.pcast(jnp.zeros_like(microbatches), (axis_name,),
-                        to="varying")
+    # carry type is stable (no-op when the activations already vary, e.g.
+    # when the embedding params were cast varying for the backward pass)
+    from ..ops.collective_ops import ensure_varying
+    state = ensure_varying(jnp.zeros_like(microbatches[0]), (axis_name,))
+    outputs = ensure_varying(jnp.zeros_like(microbatches), (axis_name,))
 
     def tick(carry, t):
         state, outputs = carry
@@ -187,7 +187,16 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
     import optax
 
     def step(pparams, opt_state, tokens):
-        loss, grads = jax.value_and_grad(per_rank_loss)(pparams, tokens)
+        # Backward pass on a device-varying copy so grads come out truly
+        # per-device (see ops.collective_ops.ensure_varying): otherwise
+        # shard_map's autodiff pre-sums the cotangents over every axis a
+        # param is replicated on, and the explicit psums below keep (or
+        # re-multiply) those sums — dp× on the layer stack, dp·pp× on the
+        # replicated embed/head/norm.
+        from ..ops.collective_ops import ensure_varying
+        vpparams = jax.tree_util.tree_map(
+            lambda p: ensure_varying(p, (dp_axis, pp_axis)), pparams)
+        loss, grads = jax.value_and_grad(per_rank_loss)(vpparams, tokens)
         # dp-average everything; pp-sum the replicated (non-stacked) params
         # — each is used on exactly one stage, so the sum is the true grad.
         grads = jax.tree_util.tree_map(
@@ -201,9 +210,7 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
         return pparams, opt_state, lax.pmean(loss, dp_axis)
 
     param_specs_tree = pipeline_param_specs(pparams)
-    opt_state_shape = jax.eval_shape(tx.init, pparams)
-    opt_specs = _mirror_opt_specs(opt_state_shape, pparams,
-                                  param_specs_tree)
+    opt_specs = trainer_mod.opt_state_specs(tx, pparams, param_specs_tree)
     batch_spec = P(dp_axis, None)
     fn = jax.jit(jax.shard_map(
         step, mesh=mesh,
@@ -217,36 +224,3 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
 
     return fn, shardings(param_specs_tree), \
         jax.sharding.NamedSharding(mesh, batch_spec)
-
-
-def _mirror_opt_specs(opt_state_shape, params, param_specs_tree):
-    """Give each optimizer-state leaf the spec of the parameter it mirrors.
-
-    Optimizer states embed param-shaped subtrees under the same dict keys
-    as the params (optax mu/nu/trace buffers), so a state leaf's key-path
-    suffix identifies its parameter deterministically; the shape must also
-    match, guarding against coincidental key collisions. Anything without a
-    matching (path-suffix, shape) — counts, scalars, schedules — is
-    replicated."""
-    def path_keys(path):
-        return tuple(str(getattr(p, "key", getattr(p, "name", None)))
-                     for p in path
-                     if hasattr(p, "key") or hasattr(p, "name"))
-
-    # params and param_specs_tree have identical structure (the specs are
-    # built by tree_map over the params), so parallel flattening aligns
-    # each param path with its spec.
-    param_leaves = jax.tree_util.tree_leaves_with_path(params)
-    spec_leaves = jax.tree_util.tree_leaves(
-        param_specs_tree, is_leaf=lambda s: isinstance(s, P))
-    by_path = {path_keys(path): (tuple(leaf.shape), spec)
-               for (path, leaf), spec in zip(param_leaves, spec_leaves)}
-
-    def spec_for(path, leaf):
-        keys = path_keys(path)
-        for i in range(len(keys)):
-            hit = by_path.get(keys[i:])
-            if hit is not None and hit[0] == tuple(leaf.shape):
-                return hit[1]
-        return P()
-    return jax.tree_util.tree_map_with_path(spec_for, opt_state_shape)

@@ -46,7 +46,14 @@ def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
     axis = axis_name or mesh.axis_names[0]
 
     def per_worker(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # Backward pass on a device-varying copy of the params — see
+        # ops.collective_ops.ensure_varying for why (replicated params
+        # would make autodiff pre-sum the grads, turning the explicit
+        # allreduce below into a no-op on an already-summed value).
+        from .ops import collective_ops as cops
+        vparams = jax.tree_util.tree_map(
+            lambda p: cops.ensure_varying(p, axis), params)
+        loss, grads = jax.value_and_grad(loss_fn)(vparams, batch)
         grads = optim.allreduce_gradients(
             grads, compression=compression, axis_name=axis,
             fusion_threshold=fusion_threshold)
@@ -67,17 +74,58 @@ def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
     return jax.jit(step, donate_argnums=donate_argnums)
 
 
+def opt_state_specs(tx, params, param_spec_tree):
+    """PartitionSpec pytree for ``tx.init(params)``: params-like leaves
+    (mu/nu/momentum buffers) inherit the corresponding param's spec; every
+    other leaf (step counts, schedule state) is replicated."""
+    state_shape = jax.eval_shape(tx.init, params)
+    return optax.tree_map_params(
+        tx, lambda _, spec: spec, state_shape, param_spec_tree,
+        transform_non_params=lambda _: P())
+
+
+def init_opt_state(tx, params, mesh, param_spec_tree=None):
+    """``tx.init(params)`` placed on the mesh: leaves mirroring a param
+    (mu/nu/trace) take that param's sharding, scalars (step counts) are
+    replicated. Use this instead of a bare ``tx.init`` with sharded steps —
+    a host-created state's scalar avals lack the mesh context, so the first
+    step call compiles one program and every later call another (the
+    feedback opt_state *does* carry the mesh context), silently doubling
+    compile time."""
+    if param_spec_tree is None:
+        param_spec_tree = jax.tree_util.tree_map(lambda _: P(), params)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        opt_state_specs(tx, params, param_spec_tree))
+    return jax.jit(tx.init, out_shardings=shardings)(params)
+
+
 def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
-                    donate=True):
+                    donate=True, params=None):
     """Sharding-annotated train step: params placed by ``param_spec_tree``
     (e.g. models.transformer.param_specs), batch by ``batch_spec``; XLA
-    (GSPMD) inserts all tp/sp/dp collectives over ICI."""
+    (GSPMD) inserts all tp/sp/dp collectives over ICI.
+
+    Pass ``params`` (the concrete or abstract param tree) so the optimizer
+    state's shardings can be derived too and every step argument/result is
+    pinned — without it, ``tx.init`` on the host yields SingleDeviceSharding
+    scalars whose shardings change after the first step, costing a silent
+    second compilation of the whole step.
+    """
 
     def to_sharding(spec):
         return NamedSharding(mesh, spec)
 
     param_shardings = jax.tree_util.tree_map(to_sharding, param_spec_tree)
     batch_sharding = to_sharding(batch_spec)
+    if params is not None:
+        opt_shardings = jax.tree_util.tree_map(
+            to_sharding, opt_state_specs(tx, params, param_spec_tree))
+        out_shardings = (param_shardings, opt_shardings,
+                         NamedSharding(mesh, P()))
+    else:
+        opt_shardings = None
+        out_shardings = None
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -88,7 +136,8 @@ def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(
         step,
-        in_shardings=(param_shardings, None, batch_sharding),
+        in_shardings=(param_shardings, opt_shardings, batch_sharding),
+        out_shardings=out_shardings,
         donate_argnums=donate_argnums), param_shardings, batch_sharding
 
 

@@ -77,6 +77,24 @@ class TestFlashForward:
         with pytest.raises(ValueError, match="divisible"):
             flash_attention(q, k, v, causal=False, block_q=64, block_k=64)
 
+    def test_block_shrinks_to_fit_seq(self, hvd):
+        # the 256 default must not reject lengths a 128-block handles:
+        # non-causal seq 384 and cross-length causal (sq != sk) shrink the
+        # block instead of raising
+        from horovod_tpu.ops.flash_attention import flash_attention
+        from horovod_tpu.parallel.ring import full_attention
+        q, k, v = _qkv(5, s=384)
+        out = flash_attention(q, k, v, causal=False)
+        want = full_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        q2, _, _ = _qkv(6, s=128)
+        _, k2, v2 = _qkv(7, s=384)
+        out2 = flash_attention(q2, k2, v2, causal=False)
+        want2 = full_attention(q2, k2, v2, causal=False)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(want2),
+                                   rtol=2e-5, atol=2e-5)
+
 
 class TestFlashBackward:
     def test_grad_matches_reference(self, hvd):

@@ -134,9 +134,9 @@ class TestMoETransformerSharded:
         tx = optax.adamw(3e-3)
         specs = tr.param_specs(params)
         step, pshard, bshard = trainer.make_gspmd_step(
-            loss_fn, tx, mesh, specs, tr.batch_spec())
+            loss_fn, tx, mesh, specs, tr.batch_spec(), params=params)
         params = jax.tree_util.tree_map(jax.device_put, params, pshard)
-        opt_state = tx.init(params)
+        opt_state = trainer.init_opt_state(tx, params, mesh, specs)
         tokens = jax.device_put(tokens, bshard)
         losses = []
         for _ in range(6):

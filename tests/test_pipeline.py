@@ -114,6 +114,41 @@ class TestTransformerPipeline:
             logits, np.asarray(tokens)[:, 1:])
         np.testing.assert_allclose(float(loss), float(expect), rtol=1e-4)
 
+    def test_step_update_matches_unpipelined(self, hvd):
+        """One pipeline step must produce the SAME parameter update as the
+        unpipelined single-device step — guards against grad overcounting
+        from shard_map's automatic cotangent psum (dp× on the layer stack,
+        dp·pp× on the replicated embed/head/norm)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from horovod_tpu.parallel import mesh as mesh_mod
+        from horovod_tpu.parallel import pipeline as pl
+        from horovod_tpu import trainer
+        mesh = mesh_mod.build_mesh(dp=4, pp=2)
+        cfg, model, params, pparams, tx, opt_state, tokens, step = \
+            self._setup(mesh)
+        p1, _, _ = step(pparams, opt_state, tokens)
+
+        def loss_fn(p, toks):
+            logits = model.apply({"params": p}, toks[:, :-1])
+            return trainer.softmax_cross_entropy(logits, toks[:, 1:])
+
+        toks = jnp.asarray(np.asarray(tokens))
+        g = jax.grad(loss_fn)(params, toks)
+        updates, _ = tx.update(g, tx.init(params), params)
+        ref = pl.stack_pipeline_params(optax.apply_updates(params, updates),
+                                       cfg.num_layers)
+        for (ka, a), (kb, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(p1),
+                       key=lambda kv: str(kv[0])),
+                sorted(jax.tree_util.tree_leaves_with_path(ref),
+                       key=lambda kv: str(kv[0]))):
+            assert str(ka) == str(kb)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-5,
+                                       err_msg=str(ka))
+
     def test_training_reduces_loss(self, hvd):
         from horovod_tpu.parallel import mesh as mesh_mod
         mesh = mesh_mod.build_mesh(dp=4, pp=2)
