@@ -1,0 +1,147 @@
+"""Distributed MNIST in PyTorch via the torch frontend.
+
+Direct counterpart of the reference's flagship example
+(examples/pytorch_mnist.py, including the CS744 fork's checkpoint/resume
+additions :175-195, :305-312): torch model and optimizer, hook-driven
+gradient allreduce through horovod_tpu's eager core, parameter +
+optimizer-state broadcast, --batches-per-allreduce accumulation, per-epoch
+rank-0 checkpointing with resume, and metric averaging across workers.
+
+Single process it degrades to ordinary torch training (1-rank Horovod
+semantics); multi-process runs via bin/hvdrun launch one torch replica per
+process.
+
+Usage:
+    python examples/pytorch_mnist.py --epochs 2
+    bin/hvdrun -np 2 python examples/pytorch_mnist.py --epochs 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    """The reference example's CNN (examples/pytorch_mnist.py:66-84)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = torch.nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = torch.nn.Dropout2d()
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="horovod_tpu torch MNIST")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.5)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--batches-per-allreduce", type=int, default=1)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--checkpoint-dir", default="./torch-mnist-ckpt")
+    p.add_argument("--data", default=None, help="path to mnist .npz")
+    p.add_argument("--steps-per-epoch", type=int, default=None)
+    return p.parse_args()
+
+
+def load_data(path, n=8192):
+    if path and os.path.exists(path):
+        with np.load(path) as d:
+            # int64: F.nll_loss requires Long targets
+            return (d["x_train"].astype(np.float32)[..., None] / 255.0,
+                    d["y_train"].astype(np.int64))
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 28, 28, 1).astype(np.float32)
+    Y = rng.randint(0, 10, n).astype(np.int64)
+    return X, Y
+
+
+def checkpoint_path(d):
+    return os.path.join(d, "checkpoint.pt")
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    torch.manual_seed(args.seed)
+    world = hvd.size()
+
+    model = Net()
+    # LR scaled by world size (reference examples/pytorch_mnist.py pattern)
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(),
+                        lr=args.lr * world * args.batches_per_allreduce,
+                        momentum=args.momentum),
+        named_parameters=model.named_parameters(),
+        compression=(hvd.Compression.fp16 if args.fp16_allreduce
+                     else hvd.Compression.none),
+        backward_passes_per_step=args.batches_per_allreduce)
+
+    start_epoch = 0
+    ckpt = checkpoint_path(args.checkpoint_dir)
+    if os.path.exists(ckpt) and hvd.rank() == 0:
+        state = torch.load(ckpt, weights_only=True)
+        model.load_state_dict(state["model"])
+        optimizer.load_state_dict(state["optimizer"])
+        start_epoch = state["epoch"] + 1
+    # everyone adopts rank 0's weights/state/epoch — the reference's
+    # resume consistency primitive (torch/__init__.py:200-348)
+    start_epoch = hvd.broadcast_object(start_epoch, root_rank=0)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    if start_epoch and hvd.rank() == 0:
+        print(f"resumed from epoch {start_epoch}")
+
+    X, Y = load_data(args.data)
+    # shard the dataset by rank (DistributedSampler role)
+    X, Y = X[hvd.rank()::world], Y[hvd.rank()::world]
+    X = torch.from_numpy(np.ascontiguousarray(X.transpose(0, 3, 1, 2)))
+    Y = torch.from_numpy(Y)
+
+    steps = args.steps_per_epoch or max(1, len(X) // args.batch_size)
+    model.train()
+    for epoch in range(start_epoch, args.epochs):
+        perm = torch.randperm(len(X))
+        epoch_loss = []
+        for i in range(steps):
+            optimizer.zero_grad()
+            for k in range(args.batches_per_allreduce):
+                idx = perm[((i * args.batches_per_allreduce + k)
+                            * args.batch_size) % len(X):][:args.batch_size]
+                loss = F.nll_loss(model(X[idx]), Y[idx])
+                (loss / args.batches_per_allreduce).backward()
+            optimizer.step()
+            epoch_loss.append(loss.item())
+        # epoch metric averaged across workers (MetricAverageCallback role)
+        avg = hvd.allreduce(torch.tensor(float(np.mean(epoch_loss))),
+                            average=True).item()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={avg:.4f}")
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict(),
+                        "epoch": epoch}, ckpt)
+
+
+if __name__ == "__main__":
+    main()

@@ -146,12 +146,14 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
 
 
 def allreduce_async(tensor, average=True, name=None,
-                    compression=Compression.none):
-    """Queue an allreduce; returns a handle (torch/mpi_ops.py:85-130)."""
+                    compression=Compression.none, kind=None):
+    """Queue an allreduce; returns a handle (torch/mpi_ops.py:85-130).
+    ``kind`` overrides the eager core's stacked/replicated shape heuristic
+    for callers that know their tensor's semantics."""
     coord = _coordinator()
     compressed, ctx = compression.compress(tensor)
     handle = coord.enqueue(_auto_name("allreduce", name), eager_mod.ALLREDUCE,
-                           compressed, average=average)
+                           compressed, average=average, kind=kind)
     if ctx is not None:
         coord.handles.get(handle).postscale = ctx  # dtype to restore
     return handle
@@ -221,10 +223,10 @@ def broadcast(tensor, root_rank=0, name=None, axis_name=None):
                                        name=name))
 
 
-def broadcast_async(tensor, root_rank=0, name=None):
+def broadcast_async(tensor, root_rank=0, name=None, kind=None):
     coord = _coordinator()
     return coord.enqueue(_auto_name("broadcast", name), eager_mod.BROADCAST,
-                         tensor, root_rank=root_rank)
+                         tensor, root_rank=root_rank, kind=kind)
 
 
 broadcast_ = broadcast

@@ -1,0 +1,167 @@
+"""Torch frontend: Horovod's torch API on the TPU-native core.
+
+TPU-native equivalent of the reference torch frontend
+(horovod/torch/__init__.py:42-348): hook-driven gradient allreduce
+overlapped with backward, handle-based async ops, parameter and
+optimizer-state broadcast. Collectives run through the same eager
+coordination core as the JAX API (one torch replica per host process);
+the training compute stays in torch.
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size()),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+import collections
+
+import torch
+
+from .compression import Compression  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    init, shutdown, is_initialized, mpi_threads_supported,
+    size, local_size, rank, local_rank, process_rank, process_count,
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    allgather, allgather_async,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    poll, synchronize)
+from .. import optim as _optim
+
+
+class _DistributedOptimizer:
+    """Mixin grafted onto the wrapped optimizer's own class: per-parameter
+    post-accumulate-grad hooks launch async allreduces as backward produces
+    each gradient; ``step`` joins them (reference torch/__init__.py:95-151).
+    ``backward_passes_per_step`` delays the allreduce so k local backwards
+    accumulate first (torch/__init__.py:71-73,114-130)."""
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(self._hook))
+
+    def _name(self, p):
+        return self._names.get(p) or f"grad.{id(p)}"
+
+    def _hook(self, p):
+        self._passes[p] += 1
+        if self._passes[p] % self.backward_passes_per_step == 0:
+            if p in self._handles:
+                raise ValueError(
+                    f"Gradient for {self._name(p)} allreduced twice "
+                    "without an optimizer step; call synchronize() or "
+                    "step() between effective batches (reference "
+                    "duplicate-submission error, torch/mpi_ops_v2.cc).")
+            self._handles[p] = allreduce_async_(
+                p.grad, average=True, name=self._name(p),
+                compression=self._compression)
+
+    def synchronize(self):
+        """Join all outstanding gradient allreduces (reference
+        torch/__init__.py:132-147). Params whose accumulation phase is
+        mid-window (an odd warm-up backward, a leftover micro-batch) are
+        flushed now so step() never applies a half-accumulated,
+        never-reduced gradient; counters reset so the next effective batch
+        starts a fresh window."""
+        if size() > 1:
+            for group in self.param_groups:
+                for p in group["params"]:
+                    if (p.requires_grad and p.grad is not None
+                            and p not in self._handles
+                            and self._passes[p]
+                            % self.backward_passes_per_step != 0):
+                        self._handles[p] = allreduce_async_(
+                            p.grad, average=True, name=self._name(p),
+                            compression=self._compression)
+        for handle in self._handles.values():
+            synchronize(handle)
+        self._handles.clear()
+        self._passes.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad with outstanding gradient allreduces; call "
+                "step() or synchronize() first (reference "
+                "torch/__init__.py zero_grad guard)")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """Wrap a constructed ``torch.optim.Optimizer`` so gradients are
+    averaged across workers during backward. As in the reference
+    (torch/__init__.py:163-198) the wrapper dynamically subclasses the
+    optimizer's own class, so step/state/param_group semantics are
+    inherited; unlike the reference it adopts the already-constructed
+    optimizer's state instead of re-running ``__init__``."""
+    methods = {k: v for k, v in _DistributedOptimizer.__dict__.items()
+               if k not in ("__dict__", "__weakref__")}
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               methods)
+    wrapped = cls.__new__(cls)
+    wrapped.__dict__.update(optimizer.__dict__)
+    wrapped._compression = compression
+    wrapped.backward_passes_per_step = backward_passes_per_step
+    named = list(named_parameters) if named_parameters is not None else []
+    dups = [n for n, c in collections.Counter(
+        n for n, _ in named).items() if c > 1]
+    if dups:
+        raise ValueError(f"named_parameters has duplicate names: {dups}")
+    wrapped._names = {p: n for n, p in named}
+    wrapped._handles = {}
+    wrapped._passes = collections.defaultdict(int)
+    wrapped._hook_handles = []
+    if size() > 1:
+        wrapped._register_hooks()
+    return wrapped
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast a ``state_dict`` or ``named_parameters`` iterable from
+    root_rank, in place (reference torch/__init__.py:200-230). Two-phase:
+    enqueue every broadcast async, then join — so the eager core can batch
+    one cycle instead of N serialized round trips."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = [broadcast_async_(p, root_rank=root_rank,
+                                name=f"bcast.{name}")
+               for name, p in items if torch.is_tensor(p)]
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast optimizer state from root_rank (reference
+    torch/__init__.py:232-348).
+
+    The whole state_dict rides the pickled-object path in ONE collective:
+    per-tensor broadcasts would require every rank to issue an identical
+    op sequence, but optimizer state diverges structurally across ranks in
+    exactly the flows this call exists for (only rank 0 loaded the
+    checkpoint, so only rank 0 has momentum/exp_avg buffers) — ranks would
+    deadlock or keep stale state. The reference solved this with scalar
+    wrapping + deferred callbacks; a single object broadcast is the
+    startup-time-appropriate modern form."""
+    state = optimizer.state_dict() \
+        if process_rank() == root_rank else None
+    state = _optim.broadcast_object(state, root_rank=root_rank)
+    if process_rank() != root_rank:
+        optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj, root_rank=0):
+    """Broadcast an arbitrary picklable object (epoch counters on resume —
+    reference examples/pytorch_mnist.py:175-195)."""
+    return _optim.broadcast_object(obj, root_rank=root_rank)

@@ -1,0 +1,167 @@
+"""Torch tensor bindings for the eager collective core.
+
+TPU-native equivalent of the reference's torch op binding
+(horovod/torch/mpi_ops.py:54-438 and the C extension mpi_ops_v2.cc:52-130):
+torch tensors bridge through NumPy into the same eager coordination core
+(handles, fusion, plan cache, stall detection) that serves the JAX API —
+the role the reference's ``EnqueueTensorAllreduce`` C API plays for its
+torch frontend. Participants are host processes (one torch replica per
+process), matching the reference's one-rank-per-process model.
+
+Handles are the core's integer handles (reference HandleManager,
+torch/handle_manager.h:30-41); ``synchronize`` optionally copies the result
+back into the submitted tensor for the in-place (``_``-suffixed) variants.
+"""
+
+import numpy as np
+import torch
+
+from .. import mpi_ops as _core
+from ..common.exceptions import NotInitializedError  # noqa: F401
+from .compression import Compression
+
+# handle -> (target tensor or None, torch dtype) for result conversion;
+# the reference keeps the same map on the Python side
+# (torch/mpi_ops.py:54 _handle_map).
+_handle_map = {}
+
+init = _core.init
+shutdown = _core.shutdown
+is_initialized = _core.is_initialized
+# torch workers are host processes (one replica per process), so the torch
+# frontend's size/rank are process-level — unlike the JAX frontend, where
+# workers are mesh devices. Matches the reference's one-rank-per-process
+# model (run/run.py spawns N python processes).
+size = _core.process_count
+rank = _core.process_rank
+process_rank = _core.process_rank
+process_count = _core.process_count
+mpi_threads_supported = _core.mpi_threads_supported
+
+
+def local_rank():
+    """Rank within this host, from the launcher's per-process env
+    (run/cli.py _rank_env); single-host fallback is the global rank —
+    preserving the `local_rank() == 0 downloads the data` idiom."""
+    import os
+    return int(os.environ.get("HVD_LOCAL_RANK", rank()))
+
+
+def local_size():
+    """Processes on this host (launcher env; single-host fallback: all)."""
+    import os
+    return int(os.environ.get("HVD_LOCAL_SIZE", size()))
+
+
+def _to_numpy(tensor):
+    if not isinstance(tensor, torch.Tensor):
+        raise ValueError(f"expected a torch.Tensor, got {type(tensor)}")
+    # copy: the eager core captures the buffer at background-flush time,
+    # not enqueue time — a zero-copy view would race with caller mutations
+    # of the tensor while the collective is in flight (the reference's
+    # fusion-buffer memcpy-in provides the same snapshot semantics,
+    # collective_operations.cc MemcpyInFusionBuffer)
+    return np.array(tensor.detach().cpu().numpy(), copy=True)
+
+
+def _to_torch(value, dtype, like=None):
+    # copy=True: np.asarray of a jax array is a zero-copy view of a buffer
+    # jax may free once the result is dropped; torch.from_numpy would alias
+    # it without owning it
+    out = torch.from_numpy(np.array(value, copy=True))
+    out = out.to(dtype)
+    if like is not None and like.device.type != "cpu":
+        out = out.to(like.device)
+    return out
+
+
+def allreduce_async(tensor, average=True, name=None,
+                    compression=Compression.none):
+    """Queue an allreduce of a torch tensor; returns an integer handle
+    (reference torch/mpi_ops.py:69-108)."""
+    compressed, ctx = compression.compress(tensor)
+    handle = _core.allreduce_async(_to_numpy(compressed), average=average,
+                                   name=name, kind="replicated")
+    _handle_map[handle] = (None, tensor.dtype if ctx is None else ctx,
+                           tensor)
+    return handle
+
+
+def allreduce_async_(tensor, average=True, name=None,
+                     compression=Compression.none):
+    """In-place async allreduce: on synchronize, the result is copied back
+    into ``tensor`` (reference torch/mpi_ops.py:133-178)."""
+    compressed, ctx = compression.compress(tensor)
+    handle = _core.allreduce_async(_to_numpy(compressed), average=average,
+                                   name=name, kind="replicated")
+    _handle_map[handle] = (tensor, tensor.dtype if ctx is None else ctx,
+                           tensor)
+    return handle
+
+
+def allreduce(tensor, average=True, name=None,
+              compression=Compression.none):
+    return synchronize(allreduce_async(tensor, average=average, name=name,
+                                       compression=compression))
+
+
+def allreduce_(tensor, average=True, name=None,
+               compression=Compression.none):
+    return synchronize(allreduce_async_(tensor, average=average, name=name,
+                                        compression=compression))
+
+
+def allgather_async(tensor, name=None):
+    """Concatenate every worker's tensor along dim 0 (reference
+    torch/mpi_ops.py:181-234)."""
+    handle = _core.allgather_async(_to_numpy(tensor), name=name,
+                                   kind="replicated")
+    _handle_map[handle] = (None, tensor.dtype, tensor)
+    return handle
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def broadcast_async(tensor, root_rank=0, name=None):
+    handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                                   name=name, kind="replicated")
+    _handle_map[handle] = (None, tensor.dtype, tensor)
+    return handle
+
+
+def broadcast_async_(tensor, root_rank=0, name=None):
+    handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                                   name=name, kind="replicated")
+    _handle_map[handle] = (tensor, tensor.dtype, tensor)
+    return handle
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    return synchronize(broadcast_async(tensor, root_rank=root_rank,
+                                       name=name))
+
+
+def broadcast_(tensor, root_rank=0, name=None):
+    return synchronize(broadcast_async_(tensor, root_rank=root_rank,
+                                        name=name))
+
+
+def poll(handle):
+    """True iff the collective behind ``handle`` has completed (reference
+    torch/mpi_ops.py:406-419)."""
+    return _core.poll(handle)
+
+
+def synchronize(handle):
+    """Block until the collective completes; returns the result tensor
+    (copied into the original for in-place handles). Reference
+    torch/mpi_ops.py:422-438."""
+    target, dtype, like = _handle_map.pop(handle)
+    result = _core.synchronize(handle)
+    out = _to_torch(result, dtype, like=like)
+    if target is not None:
+        target.data.copy_(out)
+        return target
+    return out

@@ -1,0 +1,166 @@
+"""Torch frontend: collectives on torch tensors, DistributedOptimizer
+hooks, parameter/optimizer-state broadcast (reference test_torch.py
+patterns — single-process here, so process-level collectives are identity;
+the mechanics of handles, hooks and in-place copies are what's under
+test)."""
+
+import numpy as np
+import pytest
+import torch
+
+
+@pytest.fixture
+def thvd(hvd):
+    import horovod_tpu.torch as thvd_mod
+    return thvd_mod
+
+
+class TestTorchOps:
+    def test_allreduce_identity_single_process(self, thvd):
+        x = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        out = thvd.allreduce(x, average=True)
+        assert torch.is_tensor(out)
+        np.testing.assert_allclose(out.numpy(), x.numpy())
+
+    def test_allreduce_inplace(self, thvd):
+        x = torch.ones(4) * 3
+        out = thvd.allreduce_(x, average=False)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), 3 * np.ones(4))
+
+    def test_allreduce_fp16_compression(self, thvd):
+        x = torch.randn(8)
+        out = thvd.allreduce(x, average=True,
+                             compression=thvd.Compression.fp16)
+        assert out.dtype == torch.float32
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-2)
+
+    def test_async_poll_synchronize(self, thvd):
+        x = torch.full((3,), 2.0)
+        h = thvd.allreduce_async(x, average=False)
+        out = thvd.synchronize(h)
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones(3))
+
+    def test_broadcast_inplace(self, thvd):
+        x = torch.randn(5)
+        want = x.clone()
+        out = thvd.broadcast_(x, root_rank=0)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), want.numpy())
+
+    def test_allgather(self, thvd):
+        x = torch.arange(4, dtype=torch.float32).reshape(2, 2)
+        out = thvd.allgather(x)
+        assert out.shape[0] == 2 * thvd.process_count()
+
+    def test_size_rank_are_process_level(self, thvd):
+        assert thvd.size() == thvd.process_count()
+        assert thvd.rank() == thvd.process_rank()
+
+    def test_rejects_non_tensor(self, thvd):
+        with pytest.raises(ValueError, match="torch.Tensor"):
+            thvd.allreduce(np.ones(3))
+
+    def test_async_snapshots_input(self, thvd):
+        # the enqueued value must be captured at submit time: mutating the
+        # tensor while the collective is in flight must not race
+        x = torch.full((4,), 7.0)
+        h = thvd.allreduce_async(x, average=False)
+        x.zero_()
+        out = thvd.synchronize(h)
+        np.testing.assert_allclose(out.numpy(), 7 * np.ones(4))
+
+
+class TestTorchDistributedOptimizer:
+    def _model(self):
+        torch.manual_seed(0)
+        return torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                   torch.nn.Linear(8, 1))
+
+    def test_training_converges(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters())
+        thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        torch.manual_seed(1)
+        X = torch.randn(64, 4)
+        w = torch.tensor([[1.0], [-2.0], [0.5], [0.0]])
+        Y = X @ w
+        losses = []
+        for _ in range(60):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X), Y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < 0.05 * losses[0]
+
+    def test_wrapper_preserves_optimizer_class(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.Adam(model.parameters(), lr=1e-3))
+        assert isinstance(opt, torch.optim.Adam)
+        assert opt.__class__.__name__ == "Adam"
+        assert opt.param_groups[0]["lr"] == 1e-3
+
+    def test_duplicate_named_parameters_rejected(self, thvd):
+        model = self._model()
+        p = next(model.parameters())
+        with pytest.raises(ValueError, match="duplicate"):
+            thvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=[("a", p), ("a", p)])
+
+    def test_backward_passes_per_step_accumulates(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        X = torch.randn(8, 4)
+        Y = torch.randn(8, 1)
+        opt.zero_grad()
+        for _ in range(2):
+            torch.nn.functional.mse_loss(model(X), Y).backward()
+        opt.step()  # must not raise; grads accumulated over 2 passes
+
+    def test_phase_reset_after_warmup_backward(self, thvd):
+        # an odd warm-up backward must not permanently shift the
+        # backward_passes_per_step accumulation window: synchronize()
+        # flushes mid-window grads and resets the counters
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        opt._register_hooks()  # force hooks even at size()==1
+        X, Y = torch.randn(8, 4), torch.randn(8, 1)
+        torch.nn.functional.mse_loss(model(X), Y).backward()  # warm-up
+        opt.synchronize()
+        assert not opt._passes and not opt._handles
+        opt.zero_grad()
+        for _ in range(2):
+            torch.nn.functional.mse_loss(model(X), Y).backward()
+        # both passes counted in a fresh window: allreduce fired on the 2nd
+        assert opt._handles
+        opt.step()
+        assert not opt._handles and not opt._passes
+
+    def test_broadcast_optimizer_state(self, thvd):
+        model = self._model()
+        base = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        # take a step so momentum buffers exist (the reference's deferred
+        # state problem, torch/__init__.py:232-348)
+        loss = model(torch.randn(4, 4)).sum()
+        loss.backward()
+        base.step()
+        before = {k: v.clone() for pid, ps in
+                  base.state_dict()["state"].items()
+                  for k, v in ps.items() if torch.is_tensor(v)}
+        thvd.broadcast_optimizer_state(base, root_rank=0)
+        after = {k: v for pid, ps in base.state_dict()["state"].items()
+                 for k, v in ps.items() if torch.is_tensor(v)}
+        assert base.param_groups[0]["lr"] == 0.1
+        for k in before:
+            np.testing.assert_allclose(after[k].numpy(), before[k].numpy())
