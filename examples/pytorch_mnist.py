@@ -113,12 +113,16 @@ def main():
         print(f"resumed from epoch {start_epoch}")
 
     X, Y = load_data(args.data)
+    # steps from the GLOBAL length before sharding: per-shard lengths can
+    # differ by one, and a rank running an extra step would enqueue
+    # allreduces no peer matches (DistributedSampler's padding solves the
+    # same problem in the reference)
+    steps = args.steps_per_epoch or max(1, (len(X) // world)
+                                        // args.batch_size)
     # shard the dataset by rank (DistributedSampler role)
     X, Y = X[hvd.rank()::world], Y[hvd.rank()::world]
     X = torch.from_numpy(np.ascontiguousarray(X.transpose(0, 3, 1, 2)))
     Y = torch.from_numpy(Y)
-
-    steps = args.steps_per_epoch or max(1, len(X) // args.batch_size)
     model.train()
     for epoch in range(start_epoch, args.epochs):
         perm = torch.randperm(len(X))

@@ -21,23 +21,27 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu import trainer
-from horovod_tpu.models import resnet
+from horovod_tpu import models, trainer
 
 
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=sorted(resnet.MODELS))
+                   choices=models.names())
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-worker batch size (reference default 32)")
     p.add_argument("--num-warmup-batches", type=int, default=10)
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="default: the model's canonical size (224; "
+                        "inception3 299)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="bf16 compression on gradient allreduce")
-    return p.parse_args()
+    args = p.parse_args()
+    if args.image_size is None:
+        args.image_size = models.image_size(args.model)
+    return args
 
 
 def main():
@@ -46,12 +50,15 @@ def main():
     world = hvd.size()
     batch = args.batch_size * world
 
-    model = resnet.MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16)
+    kwargs = {"dropout_rate": 0.0} if args.model.startswith("vgg") else {}
+    model = models.build(args.model, num_classes=1000, dtype=jnp.bfloat16,
+                         **kwargs)
     images = jnp.zeros((batch, args.image_size, args.image_size, 3),
                        jnp.bfloat16)
     labels = jnp.zeros((batch,), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), images[:2], train=False)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})  # VGG has no BN
 
     compression = (hvd.Compression.bf16 if args.fp16_allreduce
                    else hvd.Compression.none)

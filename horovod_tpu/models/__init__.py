@@ -1,0 +1,43 @@
+"""Model zoo: the reference's benchmark families (docs/benchmarks.md —
+ResNet, Inception V3, VGG-16) plus the framework's flagship transformer LM
+and MoE extensions.
+
+One lazily-built registry backs everything: ``build(name)`` instantiates,
+``names()`` lists (the --model choices in examples/synthetic_benchmark.py,
+mirroring the reference's torchvision getattr in
+examples/pytorch_synthetic_benchmark.py), ``image_size(name)`` gives the
+canonical benchmark input resolution.
+"""
+
+_REGISTRY = None
+
+
+def _registry():
+    global _REGISTRY
+    if _REGISTRY is None:
+        from . import inception, resnet, vgg
+        _REGISTRY = dict(resnet.MODELS)
+        _REGISTRY.update({
+            "vgg11": vgg.VGG11, "vgg16": vgg.VGG16, "vgg19": vgg.VGG19,
+            "inception3": inception.InceptionV3,
+        })
+    return _REGISTRY
+
+
+def build(name, **kwargs):
+    """Instantiate a zoo model by benchmark name."""
+    registry = _registry()
+    if name not in registry:
+        raise KeyError(
+            f"Unknown model {name!r}; available: {sorted(registry)}")
+    return registry[name](**kwargs)
+
+
+def names():
+    """All benchmark model names."""
+    return tuple(sorted(_registry()))
+
+
+def image_size(name):
+    """Canonical benchmark input resolution for a zoo model."""
+    return 299 if name == "inception3" else 224

@@ -1,0 +1,153 @@
+"""Inception V3 — the reference's headline 90%-scaling benchmark model
+(docs/benchmarks.md:6-7, README.md:56-58: "90% scaling efficiency for
+Inception V3 ... on 512 GPUs").
+
+Architecture follows Szegedy et al. 2015 (the torchvision/tf-slim layout:
+stem, 3x InceptionA, InceptionB, 4x InceptionC, InceptionD, 2x InceptionE,
+aux head omitted — the benchmarks run without it). TPU-first: NHWC, bf16
+compute / fp32 params+stats, all branches concatenated on the channel dim
+so XLA fuses each block into a handful of MXU convolutions.
+"""
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: object = 0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(64, (5, 5), padding=2)(c(48, (1, 1))(x, train), train)
+        b3 = c(96, (3, 3), padding=1)(
+            c(96, (3, 3), padding=1)(c(64, (1, 1))(x, train), train), train)
+        b4 = c(self.pool_features, (1, 1))(
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1))),
+            train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (3, 3), strides=(2, 2))(x, train)
+        b2 = c(96, (3, 3), strides=(2, 2))(
+            c(96, (3, 3), padding=1)(c(64, (1, 1))(x, train), train), train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = c(192, (7, 1), padding=((3, 3), (0, 0)))(
+            c(c7, (1, 7), padding=((0, 0), (3, 3)))(
+                c(c7, (1, 1))(x, train), train), train)
+        b3 = x
+        for f, k, p in [(c7, (1, 1), 0), (c7, (7, 1), ((3, 3), (0, 0))),
+                        (c7, (1, 7), ((0, 0), (3, 3))),
+                        (c7, (7, 1), ((3, 3), (0, 0))),
+                        (192, (1, 7), ((0, 0), (3, 3)))]:
+            b3 = c(f, k, padding=p)(b3, train)
+        b4 = c(192, (1, 1))(
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1))),
+            train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (3, 3), strides=(2, 2))(c(192, (1, 1))(x, train), train)
+        b2 = x
+        for f, k, s, p in [(192, (1, 1), (1, 1), 0),
+                           (192, (1, 7), (1, 1), ((0, 0), (3, 3))),
+                           (192, (7, 1), (1, 1), ((3, 3), (0, 0))),
+                           (192, (3, 3), (2, 2), 0)]:
+            b2 = c(f, k, strides=s, padding=p)(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([
+            c(384, (1, 3), padding=((0, 0), (1, 1)))(b2, train),
+            c(384, (3, 1), padding=((1, 1), (0, 0)))(b2, train)], axis=-1)
+        b3 = c(448, (1, 1))(x, train)
+        b3 = c(384, (3, 3), padding=1)(b3, train)
+        b3 = jnp.concatenate([
+            c(384, (1, 3), padding=((0, 0), (1, 1)))(b3, train),
+            c(384, (3, 1), padding=((1, 1), (0, 0)))(b3, train)], axis=-1)
+        b4 = c(192, (1, 1))(
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1))),
+            train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = c(32, (3, 3), strides=(2, 2))(x, train)
+        x = c(32, (3, 3))(x, train)
+        x = c(64, (3, 3), padding=1)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = c(80, (1, 1))(x, train)
+        x = c(192, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        for pool_features in (32, 64, 64):
+            x = InceptionA(pool_features, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        for _ in range(2):
+            x = InceptionE(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,58 @@
+"""VGG family — the hard case in the reference's benchmark table: VGG-16
+scales at only 68% on 512 GPUs vs 90% for ResNet/Inception
+(docs/benchmarks.md:6-7) because its ~138M params (mostly the fc layers)
+stress the allreduce path. Included so the framework's fusion/compression
+can be measured against the same communication-bound workload.
+
+TPU-first: NHWC, bf16 compute / fp32 params, and the classifier as 1x1
+matmuls on the MXU.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# layer configs: ints are conv output channels, "M" is 2x2 max-pool
+_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.5  # 0 disables (benchmarks: no dropout rng)
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.astype(self.dtype)
+        for v in _CFGS[self.depth]:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for width in (4096, 4096):
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+            if self.dropout_rate:
+                x = nn.Dropout(self.dropout_rate,
+                               deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG11(**kw):
+    return VGG(depth=11, **kw)
+
+
+def VGG16(**kw):
+    return VGG(depth=16, **kw)
+
+
+def VGG19(**kw):
+    return VGG(depth=19, **kw)

@@ -56,12 +56,17 @@ def local_size():
 def _to_numpy(tensor):
     if not isinstance(tensor, torch.Tensor):
         raise ValueError(f"expected a torch.Tensor, got {type(tensor)}")
+    t = tensor.detach().cpu()
+    # numpy has no bfloat16: ride the wire in fp32 (the sum is exact in
+    # the wider type); the restore dtype recorded at enqueue casts back
+    if t.dtype == torch.bfloat16:
+        t = t.float()
     # copy: the eager core captures the buffer at background-flush time,
     # not enqueue time — a zero-copy view would race with caller mutations
     # of the tensor while the collective is in flight (the reference's
     # fusion-buffer memcpy-in provides the same snapshot semantics,
     # collective_operations.cc MemcpyInFusionBuffer)
-    return np.array(tensor.detach().cpu().numpy(), copy=True)
+    return np.array(t.numpy(), copy=True)
 
 
 def _to_torch(value, dtype, like=None):
@@ -158,8 +163,16 @@ def synchronize(handle):
     """Block until the collective completes; returns the result tensor
     (copied into the original for in-place handles). Reference
     torch/mpi_ops.py:422-438."""
-    target, dtype, like = _handle_map.pop(handle)
+    if handle not in _handle_map:
+        raise ValueError(
+            f"handle {handle} was not created by this frontend or has "
+            "already been synchronized (reference HandleManager guard, "
+            "torch/handle_manager.h:30-41)")
+    target, dtype, like = _handle_map[handle]
+    # join first, pop after: a transient core error (StalledError) must
+    # leave the mapping intact so a retry doesn't hit a bare KeyError
     result = _core.synchronize(handle)
+    _handle_map.pop(handle, None)
     out = _to_torch(result, dtype, like=like)
     if target is not None:
         target.data.copy_(out)

@@ -37,6 +37,46 @@ def test_resnet_forward_and_param_count(hvd, name, depth_params):
     assert abs(n_params - depth_params) / depth_params < 0.1
 
 
+def test_vgg16_forward_and_param_count(hvd):
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu import models
+
+    model = models.build("vgg16", num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 224, 224, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (1, 1000)
+    n = sum(np.prod(p.shape) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    # torchvision vgg16: 138.4M params — the benchmark table's
+    # communication-bound model (docs/benchmarks.md VGG-16 68% row)
+    assert abs(n - 138_357_544) / 138_357_544 < 0.01, n
+
+
+def test_inception3_forward_and_param_count(hvd):
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu import models
+
+    model = models.build("inception3", num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    n = sum(np.prod(p.shape) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    # torchvision inception_v3 (no aux head): ~23.8M params
+    assert abs(n - 23_834_568) / 23_834_568 < 0.02, n
+
+
+def test_model_registry_rejects_unknown(hvd):
+    from horovod_tpu import models
+    import pytest as _pytest
+    with _pytest.raises(KeyError, match="Unknown model"):
+        models.build("alexnet")
+
+
 def test_transformer_forward(hvd):
     import jax
     from horovod_tpu.models import transformer as tr

@@ -61,6 +61,20 @@ class TestTorchOps:
         with pytest.raises(ValueError, match="torch.Tensor"):
             thvd.allreduce(np.ones(3))
 
+    def test_allreduce_bfloat16(self, thvd):
+        # numpy has no bf16; the bridge rides fp32 and restores the dtype
+        x = torch.randn(6, dtype=torch.bfloat16)
+        out = thvd.allreduce(x, average=True)
+        assert out.dtype == torch.bfloat16
+        np.testing.assert_allclose(out.float().numpy(), x.float().numpy())
+
+    def test_stale_handle_raises_descriptive_error(self, thvd):
+        x = torch.ones(3)
+        h = thvd.allreduce_async(x, average=False)
+        thvd.synchronize(h)
+        with pytest.raises(ValueError, match="already been synchronized"):
+            thvd.synchronize(h)
+
     def test_async_snapshots_input(self, thvd):
         # the enqueued value must be captured at submit time: mutating the
         # tensor while the collective is in flight must not race
@@ -155,11 +169,12 @@ class TestTorchDistributedOptimizer:
         loss = model(torch.randn(4, 4)).sum()
         loss.backward()
         base.step()
-        before = {k: v.clone() for pid, ps in
+        before = {(pid, k): v.clone() for pid, ps in
                   base.state_dict()["state"].items()
                   for k, v in ps.items() if torch.is_tensor(v)}
         thvd.broadcast_optimizer_state(base, root_rank=0)
-        after = {k: v for pid, ps in base.state_dict()["state"].items()
+        after = {(pid, k): v for pid, ps in
+                 base.state_dict()["state"].items()
                  for k, v in ps.items() if torch.is_tensor(v)}
         assert base.param_groups[0]["lr"] == 0.1
         for k in before:
