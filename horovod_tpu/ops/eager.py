@@ -187,6 +187,7 @@ class EagerCoordinator:
         self._shutdown = False
         self._paused = False  # test hook: lets stall detection be exercised
         self._stall_warned = set()
+        self._verified_names = set()  # cross-process checks done (per name)
         self.timeline = timeline_mod.create_from_env(
             self._config, jax.process_index() == 0)
         self.autotuner = None
@@ -239,6 +240,13 @@ class EagerCoordinator:
     def _classify(self, tensor):
         if isinstance(tensor, (list, tuple)):
             return "list"
+        # The stacked convention (row i = worker i, the pmap idiom) only
+        # exists single-controller. Multi-controller SPMD contributions are
+        # always per-process — a rank whose first dim happens to equal the
+        # world size must not silently diverge onto the stacked path while
+        # its peers run the replicated one.
+        if jax.process_count() > 1:
+            return "replicated"
         if (hasattr(tensor, "ndim") and tensor.ndim >= 1 and
                 tensor.shape[0] == self._world):
             return "stacked"
@@ -457,6 +465,18 @@ class EagerCoordinator:
         if tl:
             tl.start_activity(entry.name, op.upper())
         try:
+            # Verify on the FIRST occurrence of each tensor name. The
+            # schedule must be globally agreed (verification is itself a
+            # collective): name-order is deterministic across processes
+            # under the same-program SPMD contract, unlike per-process
+            # plan-cache hits, which diverge with batch-timing skew or
+            # data-dependent (sparse nnz) shapes. Repeat submissions skip
+            # it — the response-cache-bypass economics (RunBypass,
+            # operations.cc:1168-1215) with a coordinated condition.
+            if (entry_kind == "replicated"
+                    and entry.name not in self._verified_names):
+                self._verify_cross_process(entry, op)
+                self._verified_names.add(entry.name)
             if op == ALLREDUCE:
                 entry.result = self._allreduce_one(entry, entry_kind)
             elif op == ALLGATHER:
@@ -468,6 +488,55 @@ class EagerCoordinator:
         finally:
             if tl:
                 tl.end_activity(entry.name)
+
+    _META_DIMS = 10
+
+    def _verify_cross_process(self, entry, op):
+        """Cross-process shape/dtype/op agreement before the collective —
+        the coordinator's error checking (ConstructResponse,
+        operations.cc:209-371) without its negotiation: one fixed-size
+        metadata allgather; mismatches raise MismatchError naming the
+        tensor instead of hanging or crashing inside the transport.
+        Allgather tolerates differing first dims, everything else must
+        agree exactly."""
+        if jax.process_count() == 1:
+            return
+        import zlib
+        from jax.experimental import multihost_utils
+        t = entry.tensor
+        shape = tuple(np.shape(t))
+        if len(shape) > self._META_DIMS - 4:
+            return  # rank exceeds the descriptor; let the transport check
+        # crc32 (not hash(): hash randomization differs across processes),
+        # masked to 31 bits: jax without x64 truncates int64 through the
+        # allgather. np.result_type reads the dtype without materializing
+        # a device array on the host.
+        dtype = getattr(t, "dtype", None) or np.result_type(t)
+        dtype_id = zlib.crc32(str(dtype).encode()) & 0x7FFFFFFF
+        ops = [ALLREDUCE, ALLGATHER, BROADCAST]
+        meta = np.zeros((self._META_DIMS,), np.int32)
+        meta[0] = ops.index(op)
+        meta[1] = dtype_id
+        meta[2] = int(entry.root_rank)
+        meta[3] = len(shape)
+        meta[4:4 + len(shape)] = shape
+        all_meta = np.asarray(multihost_utils.process_allgather(meta))
+        mine = jax.process_index()
+        for p in range(all_meta.shape[0]):
+            other = all_meta[p]
+            ignore_d0 = op == ALLGATHER
+            same = (other[:4] == meta[:4]).all() and \
+                (other[5 if ignore_d0 else 4:] ==
+                 meta[5 if ignore_d0 else 4:]).all()
+            if not same:
+                raise MismatchError(
+                    f"Mismatched {op} '{entry.name}' across processes: "
+                    f"process {mine} submitted op={meta[0]} dtype_id="
+                    f"{meta[1]} root={meta[2]} shape={shape}, process {p} "
+                    f"submitted op={other[0]} dtype_id={other[1]} "
+                    f"root={other[2]} "
+                    f"shape={tuple(other[4:4 + other[3]])} "
+                    f"(ConstructResponse checks, operations.cc:209-371).")
 
     def _allreduce_one(self, entry, kind):
         if kind == "stacked":
@@ -499,10 +568,27 @@ class EagerCoordinator:
             return jnp.reshape(t, (self._world * t.shape[1],) + t.shape[2:])
         if jax.process_count() == 1:
             return jnp.asarray(entry.tensor)
+        # cross-process allgatherv: first dims may differ per rank
+        # (MPI_Allgatherv recvcounts/displacements, mpi_operations.cc:142;
+        # output math collective_operations.cc:68-105). process_allgather
+        # needs equal shapes, so exchange dim0 sizes, pad to the max,
+        # gather, then slice each rank's true extent back out.
         from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(
-            jnp.asarray(entry.tensor))
-        return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
+        t = jnp.asarray(entry.tensor)
+        if t.ndim == 0:
+            return multihost_utils.process_allgather(t)  # → [nproc]
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([t.shape[0]], np.int32)))[:, 0]
+        max0 = int(counts.max())
+        if t.shape[0] < max0:
+            pad = jnp.zeros((max0 - t.shape[0],) + t.shape[1:], t.dtype)
+            t = jnp.concatenate([t, pad], axis=0)
+        gathered = multihost_utils.process_allgather(t)
+        if (counts == max0).all():
+            return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
+        return jnp.concatenate(
+            [gathered[p, :int(counts[p])] for p in range(len(counts))],
+            axis=0)
 
     def _broadcast_one(self, entry, kind):
         if kind == "stacked":

@@ -1,0 +1,81 @@
+"""Cross-process consistency checking (the coordinator's ConstructResponse
+error checks, operations.cc:209-371): ranks submitting mismatched
+shapes/dtypes to the same named collective must get a MismatchError naming
+the tensor, not a transport hang/crash. Workers are spawned via the
+programmatic run(fn) launcher (test_spark.py-style, closures shipped by
+cloudpickle)."""
+
+from horovod_tpu.run.launch import run
+
+# NOTE: worker closures must not reference this module's globals —
+# cloudpickle would serialize them by reference and the spawned workers
+# cannot import the test module. The CPU-platform env rides run(env=...)
+# because the container's sitecustomize imports jax at interpreter start,
+# before fn runs.
+_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+class TestCrossProcessConsistency:
+    def test_matching_allreduce_succeeds(self):
+        def fn():
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            out = hvd.allreduce(np.ones((2, 3), np.float32), average=False)
+            hvd.shutdown()
+            return float(np.asarray(out)[0, 0])
+
+        assert run(fn, num_proc=2, env=_ENV) == [2.0, 2.0]
+
+    def test_shape_mismatch_raises_named_error(self):
+        def fn():
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            rank = int(os.environ["HVD_PROCESS_ID"])
+            # rank-dependent shape — the reference's error-path test
+            # pattern (test_torch.py rank-dependent dims)
+            shape = (2, 3) if rank == 0 else (2, 4)
+            try:
+                hvd.allreduce(np.ones(shape, np.float32), name="bad.shape")
+                return "no error"
+            except hvd.MismatchError as e:
+                return f"mismatch:{('bad.shape' in str(e))}"
+            finally:
+                hvd.shutdown()
+
+        assert run(fn, num_proc=2, env=_ENV) == ["mismatch:True", "mismatch:True"]
+
+    def test_dtype_mismatch_raises(self):
+        def fn():
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            rank = int(os.environ["HVD_PROCESS_ID"])
+            dtype = np.float32 if rank == 0 else np.int32
+            try:
+                hvd.allreduce(np.ones((2, 2), dtype), name="bad.dtype")
+                return "no error"
+            except hvd.MismatchError:
+                return "mismatch"
+            finally:
+                hvd.shutdown()
+
+        assert run(fn, num_proc=2, env=_ENV) == ["mismatch", "mismatch"]
+
+    def test_allgather_first_dim_may_differ(self):
+        def fn():
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            rank = int(os.environ["HVD_PROCESS_ID"])
+            x = np.full((rank + 1, 2), float(rank), np.float32)
+            out = np.asarray(hvd.allgather(x))
+            hvd.shutdown()
+            return out.shape[0]
+
+        # variable-first-dim allgatherv (MPIAllgather parity)
+        assert run(fn, num_proc=2, env=_ENV) == [3, 3]
