@@ -238,21 +238,30 @@ broadcast_async_ = broadcast_async
 # blocks of hierarchical allreduce and sequence parallelism; SURVEY.md §5).
 # ---------------------------------------------------------------------------
 
-def reducescatter(tensor, average=False, axis_name=None):
+def reducescatter(tensor, average=False, axis_name=None, name=None):
     if cops.in_traced_context(axis_name):
         return cops.reducescatter_traced(tensor, axis_name=axis_name,
                                          average=average)
-    raise NotImplementedError(
-        "Eager reducescatter is not yet supported; call inside shard_map.")
+    coord = _coordinator()
+    handle = coord.enqueue(_auto_name("reducescatter", name),
+                           eager_mod.REDUCESCATTER, tensor, average=average)
+    return synchronize(handle)
 
 
-def alltoall(tensor, axis_name=None, split_axis=0, concat_axis=0):
+def alltoall(tensor, axis_name=None, split_axis=0, concat_axis=0,
+             name=None):
     if cops.in_traced_context(axis_name):
         return cops.alltoall_traced(tensor, axis_name=axis_name,
                                     split_axis=split_axis,
                                     concat_axis=concat_axis)
-    raise NotImplementedError(
-        "Eager alltoall is not yet supported; call inside shard_map.")
+    if split_axis != 0 or concat_axis != 0:
+        raise NotImplementedError(
+            "Eager alltoall supports split_axis=concat_axis=0; other axes "
+            "are available inside shard_map-traced code.")
+    coord = _coordinator()
+    handle = coord.enqueue(_auto_name("alltoall", name),
+                           eager_mod.ALLTOALL, tensor)
+    return synchronize(handle)
 
 
 # ---------------------------------------------------------------------------

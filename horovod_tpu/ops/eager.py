@@ -59,6 +59,7 @@ from ..utils import timeline as timeline_mod
 ALLREDUCE = "allreduce"
 ALLGATHER = "allgather"
 BROADCAST = "broadcast"
+REDUCESCATTER = "reducescatter"
 ALLTOALL = "alltoall"
 
 
@@ -187,7 +188,7 @@ class EagerCoordinator:
         self._shutdown = False
         self._paused = False  # test hook: lets stall detection be exercised
         self._stall_warned = set()
-        self._verified_names = set()  # cross-process checks done (per name)
+        self._verified_sigs = set()  # cross-process checks done (signature)
         self.timeline = timeline_mod.create_from_env(
             self._config, jax.process_index() == 0)
         self.autotuner = None
@@ -267,7 +268,10 @@ class EagerCoordinator:
                         self._config.stall_shutdown_time_seconds)
         while not entry.event.is_set():
             if not self._paused:
-                self.flush()
+                # non-blocking: if another thread's flush is stuck inside a
+                # hung transport collective, waiting on its lock here would
+                # also swallow the stall deadline below
+                self.flush(blocking=False)
             if entry.event.wait(timeout=self._config.cycle_time_ms / 1000.0):
                 break
             if deadline is not None and time.monotonic() > deadline:
@@ -292,46 +296,53 @@ class EagerCoordinator:
                 log.error("background flush failed: %s", exc)
             self._check_stalled()
 
-    def flush(self):
+    def flush(self, blocking=True):
         """Drain the queue and execute everything in it (one cycle)."""
-        with self._flush_lock:
-            with self._queue_lock:
-                batch = list(self._queue)
-                self._queue.clear()
-            if not batch:
-                return
-            if self.timeline:
-                self.timeline.mark_cycle_start()
-                for e in batch:
-                    self.timeline.negotiate_end(e.name)
-            t0 = time.perf_counter()
-            # the plan depends on the (possibly autotuned) fusion threshold
-            key = (int(self._config.fusion_threshold),
-                   tuple(e.signature() for e in batch))
-            plan = self.plan_cache.get(key)
-            if plan is None:
-                plan = self._make_plan(batch)
-                self.plan_cache.put(key, plan)
-            self._execute(batch, plan)
-            if self.autotuner is not None:
-                # JAX dispatch is async: without blocking, t1-t0 measures
-                # host dispatch, not collective throughput, and the GP would
-                # tune noise. Only the tuning path pays this sync.
-                for e in batch:
-                    result = getattr(e, "result", None)
-                    if result is not None:
-                        try:
-                            jax.block_until_ready(result)
-                        except Exception:
-                            pass
-                total = sum(_entry_nbytes(e) for e in batch)
-                if self.autotuner.record_cycle(total,
-                                               time.perf_counter() - t0):
-                    # apply the next suggestion (ParameterManager::Tune)
-                    self._config.fusion_threshold = int(
-                        self.autotuner.threshold)
-                    self._config.cycle_time_ms = float(
-                        self.autotuner.cycle_time_ms)
+        if not self._flush_lock.acquire(blocking):
+            return
+        try:
+            self._flush_locked()
+        finally:
+            self._flush_lock.release()
+
+    def _flush_locked(self):
+        with self._queue_lock:
+            batch = list(self._queue)
+            self._queue.clear()
+        if not batch:
+            return
+        if self.timeline:
+            self.timeline.mark_cycle_start()
+            for e in batch:
+                self.timeline.negotiate_end(e.name)
+        t0 = time.perf_counter()
+        # the plan depends on the (possibly autotuned) fusion threshold
+        key = (int(self._config.fusion_threshold),
+               tuple(e.signature() for e in batch))
+        plan = self.plan_cache.get(key)
+        if plan is None:
+            plan = self._make_plan(batch)
+            self.plan_cache.put(key, plan)
+        self._execute(batch, plan)
+        if self.autotuner is not None:
+            # JAX dispatch is async: without blocking, t1-t0 measures
+            # host dispatch, not collective throughput, and the GP would
+            # tune noise. Only the tuning path pays this sync.
+            for e in batch:
+                result = getattr(e, "result", None)
+                if result is not None:
+                    try:
+                        jax.block_until_ready(result)
+                    except Exception:
+                        pass
+            total = sum(_entry_nbytes(e) for e in batch)
+            if self.autotuner.record_cycle(total,
+                                           time.perf_counter() - t0):
+                # apply the next suggestion (ParameterManager::Tune)
+                self._config.fusion_threshold = int(
+                    self.autotuner.threshold)
+                self._config.cycle_time_ms = float(
+                    self.autotuner.cycle_time_ms)
 
     def _make_plan(self, batch):
         """Group fusable entries (stacked allreduces by dtype/average), one
@@ -465,24 +476,33 @@ class EagerCoordinator:
         if tl:
             tl.start_activity(entry.name, op.upper())
         try:
-            # Verify on the FIRST occurrence of each tensor name. The
-            # schedule must be globally agreed (verification is itself a
-            # collective): name-order is deterministic across processes
-            # under the same-program SPMD contract, unlike per-process
-            # plan-cache hits, which diverge with batch-timing skew or
-            # data-dependent (sparse nnz) shapes. Repeat submissions skip
-            # it — the response-cache-bypass economics (RunBypass,
+            # Verify on the FIRST occurrence of each collective SIGNATURE
+            # (op/dtype/shape/root — not name: auto-generated names are
+            # fresh per call, which would re-verify every op and grow the
+            # seen-set without bound). The skip schedule must be globally
+            # agreed because verification is itself a collective;
+            # signature-order is deterministic across processes under the
+            # same-program SPMD contract, unlike per-process plan-cache
+            # hits, which diverge with batch-timing skew. Repeats skip it
+            # — response-cache-bypass economics (RunBypass,
             # operations.cc:1168-1215) with a coordinated condition.
-            if (entry_kind == "replicated"
-                    and entry.name not in self._verified_names):
-                self._verify_cross_process(entry, op)
-                self._verified_names.add(entry.name)
+            if entry_kind == "replicated":
+                vkey = self._verify_key(entry, op)
+                if vkey not in self._verified_sigs:
+                    self._verify_cross_process(entry, op)
+                    if len(self._verified_sigs) >= 65536:
+                        self._verified_sigs.clear()
+                    self._verified_sigs.add(vkey)
             if op == ALLREDUCE:
                 entry.result = self._allreduce_one(entry, entry_kind)
             elif op == ALLGATHER:
                 entry.result = self._allgather_one(entry, entry_kind)
             elif op == BROADCAST:
                 entry.result = self._broadcast_one(entry, entry_kind)
+            elif op == REDUCESCATTER:
+                entry.result = self._reducescatter_one(entry, entry_kind)
+            elif op == ALLTOALL:
+                entry.result = self._alltoall_one(entry, entry_kind)
             else:
                 raise ValueError(f"Unknown op {op}")
         finally:
@@ -491,6 +511,15 @@ class EagerCoordinator:
 
     _META_DIMS = 10
 
+    def _verify_key(self, entry, op):
+        """Signature for the verified-set: what _verify_cross_process
+        would compare, minus the name."""
+        t = entry.tensor
+        shape = tuple(np.shape(t))
+        vshape = shape[1:] if op == ALLGATHER else shape
+        dtype = getattr(t, "dtype", None) or np.result_type(t)
+        return (op, str(dtype), len(shape), vshape, int(entry.root_rank))
+
     def _verify_cross_process(self, entry, op):
         """Cross-process shape/dtype/op agreement before the collective —
         the coordinator's error checking (ConstructResponse,
@@ -498,36 +527,44 @@ class EagerCoordinator:
         metadata allgather; mismatches raise MismatchError naming the
         tensor instead of hanging or crashing inside the transport.
         Allgather tolerates differing first dims, everything else must
-        agree exactly."""
+        agree exactly. EVERY branch reaches the same allgather — a
+        locally-decided skip would leave peers blocked one-sided in it."""
         if jax.process_count() == 1:
             return
         import zlib
         from jax.experimental import multihost_utils
         t = entry.tensor
         shape = tuple(np.shape(t))
-        if len(shape) > self._META_DIMS - 4:
-            return  # rank exceeds the descriptor; let the transport check
         # crc32 (not hash(): hash randomization differs across processes),
         # masked to 31 bits: jax without x64 truncates int64 through the
         # allgather. np.result_type reads the dtype without materializing
         # a device array on the host.
         dtype = getattr(t, "dtype", None) or np.result_type(t)
         dtype_id = zlib.crc32(str(dtype).encode()) & 0x7FFFFFFF
-        ops = [ALLREDUCE, ALLGATHER, BROADCAST]
+        ops = [ALLREDUCE, ALLGATHER, BROADCAST, REDUCESCATTER, ALLTOALL]
         meta = np.zeros((self._META_DIMS,), np.int32)
         meta[0] = ops.index(op)
         meta[1] = dtype_id
         meta[2] = int(entry.root_rank)
         meta[3] = len(shape)
-        meta[4:4 + len(shape)] = shape
+        if len(shape) <= self._META_DIMS - 4:
+            meta[4:4 + len(shape)] = shape
+        else:
+            # rank exceeds the descriptor: compare a shape digest instead,
+            # in the same fixed-size collective (no one-sided skips)
+            vshape = shape[1:] if op == ALLGATHER else shape
+            meta[4] = zlib.crc32(str(vshape).encode()) & 0x7FFFFFFF
         all_meta = np.asarray(multihost_utils.process_allgather(meta))
         mine = jax.process_index()
         for p in range(all_meta.shape[0]):
             other = all_meta[p]
-            ignore_d0 = op == ALLGATHER
-            same = (other[:4] == meta[:4]).all() and \
-                (other[5 if ignore_d0 else 4:] ==
-                 meta[5 if ignore_d0 else 4:]).all()
+            if not (other[:4] == meta[:4]).all():
+                same = False
+            elif len(shape) > self._META_DIMS - 4:
+                same = other[4] == meta[4]  # digest (d0 pre-excluded)
+            else:
+                start = 5 if op == ALLGATHER else 4
+                same = (other[start:] == meta[start:]).all()
             if not same:
                 raise MismatchError(
                     f"Mismatched {op} '{entry.name}' across processes: "
@@ -600,6 +637,69 @@ class EagerCoordinator:
         return multihost_utils.broadcast_one_to_all(
             jnp.asarray(entry.tensor),
             is_source=jax.process_index() == entry.root_rank)
+
+    def _reducescatter_one(self, entry, kind):
+        """Each worker gets its 1/world shard of the elementwise-summed
+        tensor (horovod's later-version reducescatter contract; building
+        block of the hierarchical path, nccl_operations.cc:269)."""
+        world = self._world if kind == "stacked" else jax.process_count()
+
+        def scatter(summed, full_shape):
+            d0 = full_shape[0]
+            if d0 % world:
+                raise MismatchError(
+                    f"reducescatter '{entry.name}': first dim {d0} not "
+                    f"divisible by world size {world}.")
+            return jnp.reshape(summed, (world, d0 // world) + full_shape[1:])
+
+        if kind == "stacked":
+            # [world, d0, ...] rows summed; row i of the result is worker
+            # i's shard — result [world, d0/world, ...]
+            t = jnp.asarray(entry.tensor)
+            summed = jnp.sum(t, axis=0)
+            if entry.average:
+                summed = summed / world
+            return scatter(summed, t.shape[1:])
+        t = jnp.asarray(entry.tensor)
+        if jax.process_count() == 1:
+            return t
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(t)
+        summed = jnp.sum(gathered, axis=0)
+        if entry.average:
+            summed = summed / jax.process_count()
+        return scatter(summed, t.shape)[jax.process_index()]
+
+    def _alltoall_one(self, entry, kind):
+        """Worker j's chunk i goes to worker i (MPI_Alltoall semantics;
+        extension — the reference exposes no alltoall, SURVEY.md §5)."""
+        world = self._world if kind == "stacked" else jax.process_count()
+        if kind == "stacked":
+            # [world, world*k, ...] → out[i] = concat_j input[j]'s chunk i
+            t = jnp.asarray(entry.tensor)
+            if t.shape[1] % world:
+                raise MismatchError(
+                    f"alltoall '{entry.name}': dim 1 ({t.shape[1]}) not "
+                    f"divisible by world size {world}.")
+            k = t.shape[1] // world
+            # [w_src, w_dst, k, ...] → transpose → [w_dst, w_src, k, ...]
+            chunks = jnp.reshape(t, (world, world, k) + t.shape[2:])
+            out = jnp.swapaxes(chunks, 0, 1)
+            return jnp.reshape(out, (world, world * k) + t.shape[2:])
+        t = jnp.asarray(entry.tensor)
+        if jax.process_count() == 1:
+            return t
+        if t.shape[0] % world:
+            raise MismatchError(
+                f"alltoall '{entry.name}': first dim ({t.shape[0]}) not "
+                f"divisible by world size {world}.")
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(t)  # [P, d0, ...]
+        k = t.shape[0] // world
+        me = jax.process_index()
+        # my output = concat_j gathered[j]'s chunk me
+        return jnp.concatenate(
+            [gathered[j, me * k:(me + 1) * k] for j in range(world)], axis=0)
 
     def _check_gather_shapes(self, name, tensors):
         """Allgather rank/dim checks (ConstructResponse,

@@ -185,6 +185,33 @@ class TestEager:
         np.testing.assert_allclose(np.asarray(hvd.broadcast(x, root_rank=0)),
                                    x)
 
+    def test_reducescatter_stacked(self, hvd):
+        # worker i holds row i = i * ones(16); each gets its 1/8 shard of
+        # the sum (= 28 * ones(2))
+        x = np.arange(8.0)[:, None] * np.ones((8, 16))
+        out = np.asarray(hvd.reducescatter(x))
+        assert out.shape == (8, 2)
+        np.testing.assert_allclose(out, np.full((8, 2), 28.0))
+        avg = np.asarray(hvd.reducescatter(x, average=True))
+        np.testing.assert_allclose(avg, np.full((8, 2), 3.5))
+
+    def test_reducescatter_indivisible_raises(self, hvd):
+        with pytest.raises(hvd.MismatchError, match="divisible"):
+            hvd.reducescatter(np.ones((8, 15)))
+
+    def test_alltoall_stacked(self, hvd):
+        # worker j sends chunk i (value 10*j + i) to worker i; worker i
+        # ends with [10*0+i, 10*1+i, ..., 10*7+i]
+        world = 8
+        x = np.zeros((world, world), np.float32)
+        for j in range(world):
+            for i in range(world):
+                x[j, i] = 10 * j + i
+        out = np.asarray(hvd.alltoall(x))
+        assert out.shape == (world, world)
+        for i in range(world):
+            np.testing.assert_allclose(out[i], 10 * np.arange(world) + i)
+
     def test_eager_fusion_batches_small_tensors(self, hvd):
         import horovod_tpu
         coord = horovod_tpu.common.state.global_state().coordinator

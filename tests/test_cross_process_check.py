@@ -65,6 +65,27 @@ class TestCrossProcessConsistency:
 
         assert run(fn, num_proc=2, env=_ENV) == ["mismatch", "mismatch"]
 
+    def test_reducescatter_and_alltoall_cross_process(self):
+        def fn():
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            # reducescatter: both submit [4] vectors; each keeps its half
+            rs = np.asarray(hvd.reducescatter(
+                np.full((4,), r + 1.0, np.float32)))
+            # alltoall: rank r sends [10r, 10r+1]; rank i receives
+            # [10*0+i, 10*1+i]
+            a2a = np.asarray(hvd.alltoall(
+                np.asarray([10.0 * r, 10.0 * r + 1], np.float32)))
+            hvd.shutdown()
+            return (rs.tolist(), a2a.tolist())
+
+        out = run(fn, num_proc=2, env=_ENV)
+        assert out[0] == ([3.0, 3.0], [0.0, 10.0])
+        assert out[1] == ([3.0, 3.0], [1.0, 11.0])
+
     def test_allgather_first_dim_may_differ(self):
         def fn():
             import os
