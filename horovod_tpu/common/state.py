@@ -156,6 +156,21 @@ def local_rank():
     return 0
 
 
+def process_local_rank():
+    """This process's rank within its host, from the launcher's env
+    (run/cli.py _rank_env); single-host fallback: the global process rank.
+    The per-host identity the torch/TF frontends expose as local_rank()
+    (reference LOCAL communicator role)."""
+    import os
+    return int(os.environ.get("HVD_LOCAL_RANK", jax.process_index()))
+
+
+def process_local_size():
+    """Processes on this host (launcher env; fallback: all processes)."""
+    import os
+    return int(os.environ.get("HVD_LOCAL_SIZE", jax.process_count()))
+
+
 def process_rank():
     """Host-level rank (CROSS communicator analogue)."""
     _check_initialized()

@@ -1,0 +1,51 @@
+"""Keras frontend: distributed optimizer + training callbacks.
+
+TPU-native equivalent of the reference's Keras adapters (`horovod/_keras/`
+shared impl, `horovod/keras/` and `horovod/tensorflow/keras/` wrappers).
+The callbacks are backend-agnostic (weights move as numpy through the
+core); ``DistributedOptimizer`` intercepts ``apply_gradients`` and so
+serves the TF backend — on the Keras JAX backend (gradients applied
+inside jit via ``stateless_apply``) it raises and points to the pure-JAX
+``horovod_tpu.optim.DistributedOptimizer`` path.
+
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        keras.optimizers.SGD(0.01 * hvd.size())), ...)
+    model.fit(..., callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=5)])
+"""
+
+from ..tensorflow import (  # noqa: F401
+    init, shutdown, is_initialized, mpi_threads_supported,
+    size, local_size, rank, local_rank, process_rank, process_count,
+    allreduce, allgather, broadcast, Compression, DistributedOptimizer)
+from . import callbacks  # noqa: F401
+
+
+def broadcast_global_variables(model, root_rank=0):
+    """Set every worker's model weights to root_rank's (reference
+    keras/__init__.py broadcast_global_variables). Backend-agnostic:
+    weights move as numpy through the core, two-phase so one cycle fuses
+    the whole set."""
+    import numpy as np
+    from .. import mpi_ops as _core
+    weights = model.get_weights()
+    handles = [_core.broadcast_async(w, root_rank=root_rank,
+                                     name=f"kbcast.{i}", kind="replicated")
+               for i, w in enumerate(weights)]
+    model.set_weights([np.asarray(_core.synchronize(h)) for h in handles])
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None):
+    """Load a Keras model and re-wrap its optimizer in DistributedOptimizer
+    (reference _keras/__init__.py:93-109 load_model)."""
+    import keras
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        model.optimizer = DistributedOptimizer(opt)
+    return model

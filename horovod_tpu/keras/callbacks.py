@@ -1,0 +1,185 @@
+"""Keras training callbacks (reference horovod/_keras/callbacks.py:20-168,
+public wrappers keras/callbacks.py, tensorflow/keras/callbacks.py).
+
+Backend-agnostic: weights move via get_weights/set_weights, the LR via
+``optimizer.learning_rate`` — so the same callbacks serve Keras-on-TF and
+Keras-on-JAX models.
+"""
+
+import numpy as np
+
+from .. import mpi_ops as _core
+from ..common.state import process_count as size
+
+try:
+    import keras
+    _Base = keras.callbacks.Callback
+except Exception:  # pragma: no cover - keras always present in CI
+    _Base = object
+
+
+class BroadcastGlobalVariablesCallback(_Base):
+    """Broadcast initial weights from root_rank on train begin (reference
+    BroadcastGlobalVariablesCallbackImpl, _keras/callbacks.py:20-30)."""
+
+    def __init__(self, root_rank=0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done:
+            return
+        # get_weights() is numpy on every backend — go straight to the
+        # core, two-phase (enqueue all, then join) so one cycle fuses it
+        weights = self.model.get_weights()
+        handles = [_core.broadcast_async(w, root_rank=self.root_rank,
+                                         name=f"kbcast.{i}",
+                                         kind="replicated")
+                   for i, w in enumerate(weights)]
+        self.model.set_weights(
+            [np.asarray(_core.synchronize(h)) for h in handles])
+        self._done = True
+
+
+class MetricAverageCallback(_Base):
+    """Average epoch metrics over workers so logs agree everywhere
+    (reference MetricAverageCallbackImpl, _keras/callbacks.py:33-67)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or size() == 1:
+            return
+        for k, v in list(logs.items()):
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                logs[k] = float(np.asarray(_core.allreduce(
+                    np.float32(v), average=True, name=f"metric.{k}")))
+
+
+class LearningRateScheduleCallback(_Base):
+    """LR = initial_lr * multiplier(epoch), staircase or continuous, with
+    momentum correction m *= new_lr/old_lr during the adjusted batch
+    (reference LearningRateScheduleCallbackImpl,
+    _keras/callbacks.py:70-146; correction per arXiv:1706.02677)."""
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.current_epoch = None
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    # -- optimizer knobs (Keras 3 exposes Variables) --
+
+    def _get_lr(self):
+        return float(np.asarray(self.model.optimizer.learning_rate))
+
+    def _set_lr(self, lr):
+        self.model.optimizer.learning_rate = lr
+
+    def _get_momentum(self):
+        m = getattr(self.model.optimizer, "momentum", None)
+        return None if m is None else float(np.asarray(m))
+
+    def _momentum_is_variable(self):
+        # a plain-float momentum is baked into the compiled train step at
+        # trace time; per-batch mutation would silently do nothing
+        m = getattr(self.model.optimizer, "momentum", None)
+        return m is not None and hasattr(m, "assign")
+
+    _momentum_warned = False
+
+    def _warn_momentum_once(self):
+        if not LearningRateScheduleCallback._momentum_warned:
+            LearningRateScheduleCallback._momentum_warned = True
+            import warnings
+            warnings.warn(
+                "momentum correction skipped: this optimizer stores "
+                "momentum as a plain float, which compiled train steps "
+                "bake in at trace time (set run_eagerly=True or use an "
+                "optimizer with a momentum Variable).")
+
+    def _set_momentum(self, m):
+        self.model.optimizer.momentum = m
+
+    def _adjust_learning_rate(self, epoch):
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(new_lr)
+        momentum = self._get_momentum()
+        if momentum and self.momentum_correction and old_lr:
+            if not self._momentum_is_variable():
+                self._warn_momentum_once()
+                return
+            self.restore_momentum = momentum
+            self._set_momentum(momentum * new_lr / old_lr)
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum:
+            self._set_momentum(self.restore_momentum)
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            params = getattr(self, "params", None) or {}
+            self.steps_per_epoch = params.get("steps")
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "Could not autodetect steps_per_epoch; pass it to "
+                    f"{type(self).__name__}().")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Scale LR from ≈lr/size up to the full size-scaled LR over
+    ``warmup_epochs`` (reference LearningRateWarmupCallbackImpl,
+    _keras/callbacks.py:149-168; "Accurate, Large Minibatch SGD")."""
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        def multiplier(epoch):
+            epoch += 1.0 / self.steps_per_epoch
+            world = size()
+            return 1.0 / world * (epoch * (world - 1) / warmup_epochs + 1)
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._get_lr():g}.")

@@ -1,0 +1,264 @@
+"""TensorFlow frontend: Horovod's TF API on the TPU-native core.
+
+TPU-native equivalent of the reference TF frontend
+(horovod/tensorflow/__init__.py:36-316, tensorflow/mpi_ops.{py,cc}):
+collectives on eager tf.Tensors bridged through the shared eager
+coordination core (one TF replica per host process), plus the training
+integration surface — ``DistributedOptimizer`` wrapping a Keras optimizer,
+``DistributedGradientTape``, and ``broadcast_variables``. The reference's
+AsyncOpKernel C++ custom ops (tensorflow/mpi_ops.cc:276-463) are
+TPU-unnecessary: TF2 eager tensors expose their buffer without a custom
+kernel, and async handles map onto the core's handle table.
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.01 * hvd.size()))
+    hvd.broadcast_variables(model.weights, root_rank=0)
+"""
+
+import numpy as np
+
+from .. import mpi_ops as _core
+from ..common.exceptions import NotInitializedError  # noqa: F401
+
+init = _core.init
+shutdown = _core.shutdown
+is_initialized = _core.is_initialized
+# TF workers are host processes, one replica each — process-level identity,
+# like the torch frontend (reference one-rank-per-process, run/run.py).
+size = _core.process_count
+rank = _core.process_rank
+process_rank = _core.process_rank
+process_count = _core.process_count
+mpi_threads_supported = _core.mpi_threads_supported
+
+
+from ..common.state import (process_local_rank as local_rank,  # noqa: F401
+                            process_local_size as local_size)
+# the core compressors work on the numpy bridge arrays directly (and give
+# bf16 for free); the handle layer restores the original dtype
+from ..ops.compression import Compression  # noqa: F401
+
+
+# handle -> tf dtype for result conversion
+_handle_map = {}
+
+
+def _to_numpy(tensor):
+    import tensorflow as tf
+    tensor = tf.convert_to_tensor(tensor)
+    # copy: the eager core captures the buffer at background-flush time
+    # (see torch/mpi_ops.py); tf bf16 .numpy() yields an ml_dtypes array
+    # jax ingests directly
+    return np.array(tensor.numpy(), copy=True)
+
+
+def _to_tf(value, dtype):
+    import tensorflow as tf
+    return tf.cast(tf.convert_to_tensor(np.array(value, copy=True)), dtype)
+
+
+def allreduce_async(tensor, average=True, name=None,
+                    compression=Compression.none):
+    import tensorflow as tf
+    tensor = tf.convert_to_tensor(tensor)
+    handle = _core.allreduce_async(_to_numpy(tensor), average=average,
+                                   name=name, compression=compression,
+                                   kind="replicated")
+    _handle_map[handle] = tensor.dtype
+    return handle
+
+
+def allreduce(tensor, average=True, name=None,
+              compression=Compression.none):
+    """Allreduce across workers (reference tensorflow/__init__.py:36-83).
+    A ``tf.IndexedSlices`` input takes the values+indices allgather path
+    (reference :62-73)."""
+    import tensorflow as tf
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values, name=(name or "ids") + ".values")
+        indices = allgather(tensor.indices,
+                            name=(name or "ids") + ".indices")
+        if average:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    return synchronize(allreduce_async(tensor, average=average, name=name,
+                                       compression=compression))
+
+
+def allgather_async(tensor, name=None):
+    import tensorflow as tf
+    tensor = tf.convert_to_tensor(tensor)
+    handle = _core.allgather_async(_to_numpy(tensor), name=name,
+                                   kind="replicated")
+    _handle_map[handle] = tensor.dtype
+    return handle
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def broadcast_async(tensor, root_rank=0, name=None):
+    import tensorflow as tf
+    tensor = tf.convert_to_tensor(tensor)
+    handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                                   name=name, kind="replicated")
+    _handle_map[handle] = tensor.dtype
+    return handle
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    return synchronize(broadcast_async(tensor, root_rank=root_rank,
+                                       name=name))
+
+
+def poll(handle):
+    return _core.poll(handle)
+
+
+def synchronize(handle):
+    if handle not in _handle_map:
+        raise ValueError(
+            f"handle {handle} was not created by this frontend or has "
+            "already been synchronized")
+    dtype = _handle_map[handle]
+    result = _core.synchronize(handle)
+    _handle_map.pop(handle, None)
+    return _to_tf(result, dtype)
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign root_rank's values into every worker's tf.Variables
+    (reference broadcast_variables / BroadcastGlobalVariablesHook,
+    tensorflow/__init__.py:95-138). Two-phase async enqueue then join, so
+    the core batches one cycle."""
+    variables = list(variables)
+    handles = [broadcast_async(v, root_rank=root_rank,
+                               name=f"bcast.{i}.{getattr(v, 'name', '')}")
+               for i, v in enumerate(variables)]
+    for v, h in zip(variables, handles):
+        v.assign(synchronize(h))
+
+
+class DistributedGradientTape:
+    """tf.GradientTape wrapper whose ``gradient()`` averages the grads
+    across workers (reference tensorflow/__init__.py:242-316)."""
+
+    def __init__(self, tape, compression=Compression.none):
+        self._tape = tape
+        self._compression = compression
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        if size() == 1:
+            return grads
+        flat, structure = _flatten(grads)
+        handles = [None if g is None else
+                   allreduce_async(g, average=True, name=f"dgrad.{i}",
+                                   compression=self._compression)
+                   for i, g in enumerate(flat)]
+        flat = [g if h is None else synchronize(h)
+                for g, h in zip(flat, handles)]
+        return _unflatten(flat, structure)
+
+
+def _flatten(grads):
+    if isinstance(grads, (list, tuple)):
+        return list(grads), type(grads)
+    return [grads], None
+
+
+def _unflatten(flat, structure):
+    if structure is None:
+        return flat[0]
+    return structure(flat)
+
+
+def _allreduce_grads(grads, compression):
+    """Average a list of grads, two-phase (enqueue all, then join) so the
+    core fuses one cycle."""
+    handles = [None if g is None else
+               allreduce_async(g, average=True, name=f"grad.{i}",
+                               compression=compression)
+               for i, g in enumerate(grads)]
+    return [g if h is None else synchronize(h)
+            for g, h in zip(grads, handles)]
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none):
+    """Wrap a Keras optimizer so ``apply_gradients`` first averages the
+    gradients across workers (reference DistributedOptimizer overriding
+    compute_gradients, tensorflow/__init__.py:141-239 — TF2/Keras 3 moved
+    the seam to apply_gradients).
+
+    Inside a compiled ``tf.function`` train step (Keras ``fit``), the
+    allreduce rides ONE ``tf.py_function`` covering every gradient — the
+    role of the reference's custom AsyncOpKernels
+    (tensorflow/mpi_ops.cc:276-304), and a single host call keeps the
+    collective order identical on all workers regardless of TF's graph
+    scheduling. py_function cannot be lowered by XLA: pass
+    ``jit_compile=False`` to ``model.compile`` on hosts with accelerators
+    (Keras auto-enables XLA there).
+
+    Keras-on-JAX note: the JAX trainer applies gradients via
+    ``stateless_apply`` inside jit and never calls ``apply_gradients``, so
+    this wrapper cannot intercept it — use the pure-JAX path
+    (``horovod_tpu.optim.DistributedOptimizer`` over optax with
+    ``trainer.make_data_parallel_step``) for distributed Keras-on-JAX
+    training; a guard below raises rather than silently skip averaging."""
+    import keras
+    if keras.backend.backend() == "jax" and size() > 1:
+        raise ValueError(
+            "DistributedOptimizer cannot intercept gradient application on "
+            "the Keras JAX backend (stateless_apply runs inside jit and "
+            "bypasses apply_gradients) — gradients would silently go "
+            "un-averaged. Use horovod_tpu.optim.DistributedOptimizer with "
+            "trainer.make_data_parallel_step for JAX training.")
+    import tensorflow as tf
+    base_cls = optimizer.__class__
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        grads_and_vars = list(grads_and_vars)
+        if size() > 1:
+            grads = [g for g, _ in grads_and_vars]
+            variables = [v for _, v in grads_and_vars]
+            present = [i for i, g in enumerate(grads) if g is not None]
+            dense = [tf.convert_to_tensor(grads[i]) for i in present]
+            if tf.executing_eagerly():
+                reduced = _allreduce_grads(dense, self._hvd_compression)
+            else:
+                comp = self._hvd_compression
+
+                def _host_allreduce(*flat):
+                    return _allreduce_grads(list(flat), comp)
+
+                reduced = tf.py_function(
+                    _host_allreduce, dense,
+                    Tout=[g.dtype for g in dense])
+                for r, g in zip(reduced, dense):
+                    r.set_shape(g.shape)
+            for i, r in zip(present, reduced):
+                grads[i] = r
+            grads_and_vars = list(zip(grads, variables))
+        return base_cls.apply_gradients(self, grads_and_vars,
+                                        *args, **kwargs)
+
+    cls = type(base_cls.__name__, (base_cls,),
+               {"apply_gradients": apply_gradients})
+    wrapped = cls.__new__(cls)
+    wrapped.__dict__.update(optimizer.__dict__)
+    wrapped._hvd_compression = compression
+    return wrapped

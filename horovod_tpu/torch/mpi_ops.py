@@ -39,18 +39,8 @@ process_count = _core.process_count
 mpi_threads_supported = _core.mpi_threads_supported
 
 
-def local_rank():
-    """Rank within this host, from the launcher's per-process env
-    (run/cli.py _rank_env); single-host fallback is the global rank —
-    preserving the `local_rank() == 0 downloads the data` idiom."""
-    import os
-    return int(os.environ.get("HVD_LOCAL_RANK", rank()))
-
-
-def local_size():
-    """Processes on this host (launcher env; single-host fallback: all)."""
-    import os
-    return int(os.environ.get("HVD_LOCAL_SIZE", size()))
+from ..common.state import (process_local_rank as local_rank,  # noqa: F401
+                            process_local_size as local_size)
 
 
 def _to_numpy(tensor):

@@ -1,0 +1,154 @@
+"""TF + Keras frontends: collectives on tf tensors, DistributedOptimizer /
+DistributedGradientTape, broadcast_variables, Keras callbacks (reference
+test_tensorflow.py / test_keras.py patterns — single-process, so the
+mechanics rather than cross-worker numerics are under test)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture
+def tfhvd(hvd):
+    import horovod_tpu.tensorflow as tfhvd_mod
+    return tfhvd_mod
+
+
+@pytest.fixture
+def khvd(hvd):
+    import horovod_tpu.keras as khvd_mod
+    return khvd_mod
+
+
+class TestTfOps:
+    def test_allreduce(self, tfhvd):
+        x = tf.constant([1.0, 2.0, 3.0])
+        out = tfhvd.allreduce(x, average=True)
+        assert out.dtype == tf.float32
+        np.testing.assert_allclose(out.numpy(), x.numpy())
+
+    def test_allreduce_fp16_compression(self, tfhvd):
+        x = tf.random.normal([8])
+        out = tfhvd.allreduce(x, average=True,
+                              compression=tfhvd.Compression.fp16)
+        assert out.dtype == tf.float32
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-2)
+
+    def test_allreduce_bfloat16(self, tfhvd):
+        x = tf.cast(tf.constant([1.5, 2.5]), tf.bfloat16)
+        out = tfhvd.allreduce(x, average=False)
+        assert out.dtype == tf.bfloat16
+        np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(),
+                                   [1.5, 2.5])
+
+    def test_indexed_slices_allreduce(self, tfhvd):
+        s = tf.IndexedSlices(tf.constant([[1.0, 2.0], [3.0, 4.0]]),
+                             tf.constant([0, 3]),
+                             dense_shape=tf.constant([5, 2]))
+        out = tfhvd.allreduce(s, average=True)
+        assert isinstance(out, tf.IndexedSlices)
+        np.testing.assert_allclose(out.values.numpy(),
+                                   [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_async_poll_synchronize(self, tfhvd):
+        h = tfhvd.allreduce_async(tf.ones([3]) * 4, average=False)
+        out = tfhvd.synchronize(h)
+        np.testing.assert_allclose(out.numpy(), 4 * np.ones(3))
+        with pytest.raises(ValueError, match="already been synchronized"):
+            tfhvd.synchronize(h)
+
+    def test_broadcast_variables(self, tfhvd):
+        v = tf.Variable([5.0, 6.0])
+        want = v.numpy()
+        tfhvd.broadcast_variables([v], root_rank=0)
+        np.testing.assert_allclose(v.numpy(), want)
+
+    def test_size_rank_process_level(self, tfhvd):
+        assert tfhvd.size() == tfhvd.process_count()
+        assert tfhvd.rank() == tfhvd.process_rank()
+
+
+class TestTfTraining:
+    def test_distributed_gradient_tape(self, tfhvd):
+        w = tf.Variable([[2.0], [1.0]])
+        x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+        with tfhvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_mean((x @ w) ** 2)
+        grads = tape.gradient(loss, [w])
+        expect = tf.GradientTape()
+        with expect as t2:
+            loss2 = tf.reduce_mean((x @ w) ** 2)
+        np.testing.assert_allclose(np.asarray(grads[0]),
+                                   np.asarray(t2.gradient(loss2, [w])[0]))
+
+    def test_distributed_optimizer_trains(self, tfhvd):
+        opt = tfhvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+        assert isinstance(opt, keras.optimizers.SGD)
+        w = tf.Variable([[2.0], [-1.0]])
+        x = tf.constant([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+        y = tf.constant([[1.0], [2.0], [3.0]])
+        for _ in range(150):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean((x @ w - y) ** 2)
+            opt.apply_gradients(zip(tape.gradient(loss, [w]), [w]))
+        assert float(loss) < 1e-3
+        np.testing.assert_allclose(w.numpy(), [[1.0], [2.0]], atol=1e-2)
+
+
+class TestKerasFrontend:
+    def _model(self):
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(1)])
+        return model
+
+    def test_fit_with_callbacks(self, khvd):
+        model = self._model()
+        model.compile(optimizer=khvd.DistributedOptimizer(
+            keras.optimizers.SGD(0.05, momentum=0.9)), loss="mse")
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = (X @ np.array([[1.0], [-2.0], [0.5], [0.0]],
+                          np.float32))
+        hist = model.fit(
+            X, Y, epochs=6, batch_size=16, verbose=0,
+            callbacks=[
+                khvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                khvd.callbacks.MetricAverageCallback(),
+                khvd.callbacks.LearningRateWarmupCallback(
+                    warmup_epochs=3, steps_per_epoch=4, verbose=0)])
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0]
+        assert "lr" in hist.history
+
+    def test_warmup_reaches_full_lr(self, khvd):
+        model = self._model()
+        base_lr = 0.08
+        model.compile(optimizer=keras.optimizers.SGD(base_lr), loss="mse")
+        cb = khvd.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=4)
+        X = np.random.RandomState(1).randn(32, 4).astype(np.float32)
+        Y = np.zeros((32, 1), np.float32)
+        model.fit(X, Y, epochs=3, batch_size=8, verbose=0, callbacks=[cb])
+        # single worker: multiplier → 1.0 after warmup
+        assert abs(float(np.asarray(model.optimizer.learning_rate))
+                   - base_lr) < 1e-6
+
+    def test_broadcast_global_variables(self, khvd):
+        model = self._model()
+        before = [w.copy() for w in model.get_weights()]
+        khvd.broadcast_global_variables(model, root_rank=0)
+        for a, b in zip(model.get_weights(), before):
+            np.testing.assert_allclose(a, b)
+
+    def test_load_model_rewraps_optimizer(self, khvd, tmp_path):
+        model = self._model()
+        model.compile(optimizer=keras.optimizers.SGD(0.01), loss="mse")
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        loaded = khvd.load_model(path)
+        assert type(loaded.optimizer).__name__ == "SGD"
+        assert hasattr(loaded.optimizer, "_hvd_compression")
