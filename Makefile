@@ -1,8 +1,8 @@
 # Developer entry points (reference: setup.py + .buildkite/gen-pipeline.sh).
 
 PY ?= python
-CPU_MESH = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-           XLA_FLAGS=--xla_force_host_platform_device_count=8
+CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+CPU_MESH = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test native bench examples ci clean
 
@@ -27,9 +27,17 @@ examples:
 	    --checkpoint-dir /tmp/hvd-ci-imagenet-ckpt
 	$(CPU_MESH) $(PY) examples/transformer_lm.py --size tiny --steps 3 \
 	    --dp 2 --tp 2 --sp 2 --attention ring
+	$(CPU_MESH) $(PY) examples/synthetic_benchmark.py --model resnet18 \
+	    --batch-size 1 --image-size 32 --num-warmup-batches 1 \
+	    --num-iters 1 --num-batches-per-iter 2
+	$(CPU_ENV) $(PY) examples/pytorch_mnist.py \
+	    --epochs 1 --steps-per-epoch 4 --checkpoint-dir /tmp/hvd-ci-torch-ckpt
+	$(CPU_ENV) $(PY) examples/keras_mnist.py \
+	    --epochs 1 --steps-per-epoch 4 --checkpoint-dir /tmp/hvd-ci-keras-ckpt
 	$(CPU_MESH) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 ci: native test examples
 
 clean:
-	rm -rf build dist *.egg-info /tmp/hvd-ci-imagenet-ckpt
+	rm -rf build dist *.egg-info /tmp/hvd-ci-imagenet-ckpt \
+	    /tmp/hvd-ci-torch-ckpt /tmp/hvd-ci-keras-ckpt

@@ -129,9 +129,9 @@ def main():
                            dtype=np.int64).astype(np.int32)
         return jax.device_put(jnp.asarray(toks), batch_sharding)
 
-    # compile + warmup
+    # compile + warmup (scalar read = true barrier, see timing note below)
     params, opt_state, loss = step(params, opt_state, batch_tokens())
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.perf_counter()
     tokens_done = 0
@@ -150,7 +150,10 @@ def main():
                 print("skipping checkpoint: params span non-addressable "
                       "devices (multi-host sharded); gather or use "
                       "per-process checkpointing")
-    jax.block_until_ready(loss)
+    # scalar transfer, not block_until_ready: on remote-attached platforms
+    # only a device→host read is a true execution barrier (same lesson as
+    # bench.py's sync comments)
+    float(loss)
     dt = time.perf_counter() - t0
 
     if verbose:
