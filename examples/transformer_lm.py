@@ -66,6 +66,10 @@ def parse_args():
                    help="experts per MoE layer; 0 = dense MLP")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (HBM for FLOPs)")
+    p.add_argument("--vocab-chunk", type=int, default=0,
+                   help="compute the loss blockwise over this many vocab "
+                        "entries instead of materializing [B,S,V] logits "
+                        "(memory-bound large-batch/long-seq configs)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--bench", action="store_true",
                    help="skip checkpointing/logging; print tokens/sec")
@@ -107,7 +111,7 @@ def main():
         0.0, args.lr, args.warmup_steps, max(args.steps, 2 * args.warmup_steps))
     tx = optax.adamw(sched, weight_decay=0.01)
 
-    loss_fn = tr.lm_loss_fn(model)
+    loss_fn = tr.lm_loss_fn(model, vocab_chunk=args.vocab_chunk)
     specs = tr.param_specs(params)
     step, param_shardings, batch_sharding = trainer.make_gspmd_step(
         loss_fn, tx, mesh, specs, tr.batch_spec(sp=args.sp > 1),

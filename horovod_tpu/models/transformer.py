@@ -23,6 +23,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
@@ -178,7 +179,10 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden=False):
+        """Logits [B, S, vocab]; with ``return_hidden=True``, the final-norm
+        hidden states [B, S, d_model] instead — the pre-head activations the
+        chunked-vocab loss consumes without materializing the logits."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model,
                      dtype=cfg.dtype, name="embed")(tokens)
@@ -196,9 +200,11 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, sp=sp, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        if return_hidden:
+            # lm_head params still exist: init() runs the default path
+            return x
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        name="lm_head")(x).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -244,7 +250,63 @@ def batch_spec(sp=False):
     return P("dp", "sp" if sp else None)
 
 
-def lm_loss_fn(model, aux_weight=0.01):
+def chunked_softmax_cross_entropy(hidden, head_kernel, targets,
+                                  chunk=8192):
+    """Mean next-token cross entropy WITHOUT materializing the
+    [B, S, vocab] logits: a ``lax.scan`` over vocab chunks of the lm_head
+    matmul with an online (running max + sum-exp) logsumexp, rematerialized
+    in the backward pass.
+
+    Why: for GPT-2-small at batch 8 × seq 1024 the fp32 logits alone are
+    ~1.6 GB of HBM — often THE activation-memory ceiling of an LM step.
+    Chunking caps the live logits at [B, S, chunk] for ~2× extra head
+    FLOPs (a few % of the step), the standard memory/FLOPs trade on TPU
+    (HBM is the bottleneck, SURVEY.md §7 hard parts).
+
+    ``hidden`` [B, S, D] (any dtype), ``head_kernel`` [D, V],
+    ``targets`` [B, S] int ids.
+    """
+    d, v = head_kernel.shape
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    chunk = min(chunk, v)
+    n = -(-v // chunk)
+    pad = n * chunk - v
+    if pad:
+        head_kernel = jnp.pad(head_kernel, ((0, 0), (0, pad)))
+    kc = jnp.moveaxis(
+        head_kernel.reshape(d, n, chunk), 1, 0)  # [n, D, chunk]
+
+    def body(carry, xs):
+        m, s, tgt_logit = carry
+        k_i, idx0 = xs
+        logits = jnp.einsum("bsd,dc->bsc", hidden,
+                            k_i.astype(hidden.dtype)).astype(jnp.float32)
+        col = idx0 + jnp.arange(chunk)
+        logits = jnp.where(col[None, None, :] < v, logits, -jnp.inf)
+        new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = (s * jnp.exp(m - new_m)
+             + jnp.sum(jnp.exp(logits - new_m[..., None]), axis=-1))
+        in_chunk = (targets >= idx0) & (targets < idx0 + chunk)
+        loc = jnp.clip(targets - idx0, 0, chunk - 1)
+        t = jnp.take_along_axis(logits, loc[..., None], axis=-1)[..., 0]
+        tgt_logit = jnp.where(in_chunk, t, tgt_logit)
+        return (new_m, s, tgt_logit), None
+
+    init = (jnp.full(targets.shape, -jnp.inf, jnp.float32),
+            jnp.zeros(targets.shape, jnp.float32),
+            jnp.zeros(targets.shape, jnp.float32))
+    # remat: the scan's VJP would otherwise save every chunk's logits —
+    # the exact buffer this function exists to avoid. prevent_cse=False is
+    # the documented form for checkpoint-under-scan (no optimization
+    # barriers needed there).
+    (m, s, tgt_logit), _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), init,
+        (kc, jnp.arange(n, dtype=jnp.int32) * chunk))
+    return jnp.mean(m + jnp.log(s) - tgt_logit)
+
+
+def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
     """Next-token loss for TransformerLM that automatically includes the
     MoE load-balance auxiliary loss when cfg.num_experts > 0.
 
@@ -252,6 +314,12 @@ def lm_loss_fn(model, aux_weight=0.01):
     configs: a plain ``model.apply`` without the mutable collection
     silently discards the sown aux loss and the router trains with no
     load-balancing pressure.
+
+    ``vocab_chunk > 0`` computes the cross entropy blockwise over the
+    vocab (chunked_softmax_cross_entropy) instead of materializing the
+    full logits — the memory-bound large-batch/long-seq configuration.
+    Best with pure data parallelism; under tp the head kernel is
+    vocab-sharded and the chunking reshape forces a gather.
     """
     from .. import trainer as trainer_mod
 
@@ -259,10 +327,24 @@ def lm_loss_fn(model, aux_weight=0.01):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         if model.cfg.num_experts > 0:
             from .moe import aux_loss_from
-            logits, mut = model.apply({"params": params}, inputs,
-                                      mutable=["losses"])
-            return (trainer_mod.softmax_cross_entropy(logits, targets)
-                    + aux_loss_from(mut, weight=aux_weight))
+            if vocab_chunk:
+                hidden, mut = model.apply({"params": params}, inputs,
+                                          return_hidden=True,
+                                          mutable=["losses"])
+                ce = chunked_softmax_cross_entropy(
+                    hidden, params["lm_head"]["kernel"], targets,
+                    chunk=vocab_chunk)
+            else:
+                logits, mut = model.apply({"params": params}, inputs,
+                                          mutable=["losses"])
+                ce = trainer_mod.softmax_cross_entropy(logits, targets)
+            return ce + aux_loss_from(mut, weight=aux_weight)
+        if vocab_chunk:
+            hidden = model.apply({"params": params}, inputs,
+                                 return_hidden=True)
+            return chunked_softmax_cross_entropy(
+                hidden, params["lm_head"]["kernel"], targets,
+                chunk=vocab_chunk)
         logits = model.apply({"params": params}, inputs)
         return trainer_mod.softmax_cross_entropy(logits, targets)
     return loss_fn
