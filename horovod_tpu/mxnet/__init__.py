@@ -1,0 +1,141 @@
+"""MXNet frontend: Horovod's MXNet API on the TPU-native core.
+
+TPU-native equivalent of the reference MXNet frontend
+(horovod/mxnet/__init__.py:38-150): ``DistributedOptimizer`` allreduces
+gradients inside ``update()`` with rescale_grad normalized by size, the
+gluon ``DistributedTrainer`` replaces kvstore push/pull with allreduce,
+and ``broadcast_parameters`` handles deferred-init parameters by hooking
+their ``_init_impl``. Collectives run through the same eager coordination
+core as the JAX/torch/TF frontends.
+
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    trainer = hvd.DistributedTrainer(model.collect_params(), "sgd",
+                                     {"learning_rate": 0.01 * hvd.size()})
+    hvd.broadcast_parameters(model.collect_params(), root_rank=0)
+"""
+
+import types
+import warnings
+
+try:
+    import mxnet as mx
+except ImportError as _e:  # pragma: no cover - exercised only without mxnet
+    raise ImportError(
+        "horovod_tpu.mxnet requires the mxnet package (reference gate: "
+        "check_extension('horovod.mxnet', ...), "
+        "horovod/mxnet/__init__.py:22-23)") from _e
+
+from .mpi_ops import (  # noqa: F401
+    init, shutdown, is_initialized, mpi_threads_supported,
+    size, local_size, rank, local_rank, process_rank, process_count,
+    allreduce, allreduce_, grouped_allreduce_,
+    allgather, broadcast, broadcast_)
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wraps an mx.optimizer.Optimizer: allreduce(sum) each gradient in
+    ``update()`` and fold the 1/size average into ``rescale_grad``
+    (reference mxnet/__init__.py:38-74, which notes the rescale trick
+    outperforms averaging on the wire)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._optimizer.rescale_grad /= size()
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if isinstance(index, (tuple, list)):
+            grouped_allreduce_(list(grad), average=False,
+                               name="grad." + ".".join(map(str, index)))
+        else:
+            allreduce_(grad, average=False, name=str(index))
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """gluon Trainer whose gradient exchange is allreduce instead of
+    kvstore push/pull, with the 1/size average folded into ``_scale``
+    (reference mxnet/__init__.py:83-102)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None):
+        if isinstance(optimizer, DistributedOptimizer):
+            optimizer = optimizer._optimizer
+            warnings.warn("DistributedTrainer does not take "
+                          "DistributedOptimizer as its optimizer. We have "
+                          "unwrapped it for you.")
+        super().__init__(params, optimizer,
+                         optimizer_params=optimizer_params, kvstore=None)
+        self._scale /= size()
+
+    def _allreduce_grads(self):
+        grads = [param.list_grad()[0] for param in self._params
+                 if param.grad_req != "null"]
+        if grads:
+            grouped_allreduce_(grads, average=False, name="trainer.grads")
+
+
+def _append_broadcast_init(param, root_rank):
+    """Wrap a deferred-init parameter's ``_init_impl`` so the broadcast
+    happens right after the shape is finally known (reference
+    mxnet/__init__.py:106-113)."""
+    init_impl = getattr(param, "_init_impl")
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank)
+        self.data().wait_to_read()
+
+    return wrapped_init_impl
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast ``Module.get_params()`` / ``Block.collect_params()`` from
+    root to all processes; deferred-init parameters broadcast after their
+    first initialization (reference mxnet/__init__.py:116-150)."""
+    pd_cls = getattr(mx.gluon.parameter, "ParameterDict", None)
+    if pd_cls is not None and isinstance(params, pd_cls):
+        items = sorted(params.items())
+    elif isinstance(params, dict):
+        # MXNet 2.x collect_params() returns a plain dict[str, Parameter];
+        # Module.get_params() yields dicts of NDArrays — both land here
+        items = sorted(params.items())
+    else:
+        raise ValueError(f"invalid params of type: {type(params)}")
+
+    tensors = []
+    for _, p in items:
+        if hasattr(p, "asnumpy"):  # already an NDArray
+            tensors.append(p)
+            continue
+        try:
+            tensors.append(p.data())
+        except mx.gluon.parameter.DeferredInitializationError:
+            p._init_impl = types.MethodType(
+                _append_broadcast_init(p, root_rank), p)
+
+    for i, tensor in enumerate(tensors):
+        broadcast_(tensor, root_rank, str(i))
+    for tensor in tensors:
+        tensor.wait_to_read()

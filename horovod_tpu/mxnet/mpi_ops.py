@@ -1,0 +1,164 @@
+"""MXNet NDArray bindings for the eager collective core.
+
+TPU-native equivalent of the reference's MXNet op binding
+(horovod/mxnet/mpi_ops.py:45-197 and the C++ engine push in
+horovod/mxnet/mpi_ops.cc:21-60): NDArrays bridge through NumPy into the
+same eager coordination core (handles, fusion planner, plan cache, stall
+detection) that serves the JAX and torch APIs. Participants are host
+processes (one MXNet replica per process), matching the reference's
+one-rank-per-process model.
+
+The reference returns immediately and lets the MXNet engine order the
+async work by ``priority`` (mxnet/mpi_ops.py:64-65); here the eager core's
+background thread provides the asynchrony, every op joins its collective
+before returning, and ``priority`` is accepted for signature parity but
+ignored — submission order is SPMD program order, and
+``grouped_allreduce_`` fuses by dtype under the fusion threshold instead
+of engine priorities. ``wait_to_read()`` on a returned array is a no-op
+barrier because results are materialized at return, which preserves the
+reference's calling conventions (mxnet/__init__.py:148-150).
+
+MXNet itself is imported lazily: the module only needs an ``mxnet.nd``
+array constructor to build outputs, so any numpy-compatible stand-in
+registered as ``mxnet`` works (the tests exercise exactly that, per the
+reference's own CI strategy of running frontends against whatever build
+is present, setup.py:505-520).
+"""
+
+import numpy as np
+
+from .. import mpi_ops as _core
+from ..common.exceptions import NotInitializedError  # noqa: F401
+from ..common.state import (process_local_rank as local_rank,  # noqa: F401
+                            process_local_size as local_size)
+
+init = _core.init
+shutdown = _core.shutdown
+is_initialized = _core.is_initialized
+# MXNet workers are host processes (one replica per process): size/rank are
+# process-level, like the torch frontend and the reference's
+# one-rank-per-process model.
+size = _core.process_count
+rank = _core.process_rank
+process_rank = _core.process_rank
+process_count = _core.process_count
+mpi_threads_supported = _core.mpi_threads_supported
+
+
+def _mx():
+    import mxnet
+    return mxnet
+
+
+def _to_numpy(tensor):
+    if not hasattr(tensor, "asnumpy"):
+        raise ValueError(
+            f"expected an mxnet NDArray (has .asnumpy), got {type(tensor)}")
+    # no extra copy: real MXNet's asnumpy() already synchronizes the engine
+    # and returns a fresh buffer, and every frontend op joins its
+    # collective before returning, so the caller cannot mutate the tensor
+    # while it is in flight
+    return np.asarray(tensor.asnumpy())
+
+
+def _from_numpy(value, like):
+    mx = _mx()
+    arr = np.asarray(value).astype(np.dtype(like.dtype), copy=False)
+    ctx = getattr(like, "context", None)
+    if ctx is not None:
+        return mx.nd.array(arr, ctx=ctx, dtype=arr.dtype)
+    return mx.nd.array(arr, dtype=arr.dtype)
+
+
+def _write_inplace(tensor, value):
+    arr = np.asarray(value).astype(np.dtype(tensor.dtype), copy=False)
+    tensor[:] = arr
+    return tensor
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    """Sum/average ``tensor`` over all processes into a new NDArray
+    (reference mxnet/mpi_ops.py:45-85)."""
+    del priority  # single op: nothing to order against
+    handle = _core.allreduce_async(_to_numpy(tensor), average=average,
+                                   name=name, kind="replicated")
+    return _from_numpy(_core.synchronize(handle), tensor)
+
+
+def allreduce_(tensor, average=True, name=None, priority=0):
+    """In-place allreduce (reference mxnet/mpi_ops.py:87-119)."""
+    del priority
+    handle = _core.allreduce_async(_to_numpy(tensor), average=average,
+                                   name=name, kind="replicated")
+    return _write_inplace(tensor, _core.synchronize(handle))
+
+
+_grouped_counter = [0]
+
+
+def grouped_allreduce_(tensors, average=True, name=None, priority=0):
+    """In-place allreduce of many tensors as few collectives: same-dtype
+    tensors are flattened and concatenated into buckets of at most
+    HOROVOD_FUSION_THRESHOLD bytes (the FuseResponses algorithm,
+    operations.cc:450-573), one core allreduce per bucket, results split
+    back. Bucketing happens here at the API level, so every process fuses
+    identically by SPMD program order — no cross-process negotiation of
+    batch composition is needed, unlike the reference's coordinator.
+    All buckets are enqueued before any is joined, so they overlap in the
+    core's background cycle. ``name`` prefixes the bucket collectives
+    (reference grouped-op keying); ``priority`` is accepted for signature
+    parity with the engine-ordered reference ops."""
+    del priority
+    if not tensors:
+        return tensors
+    from ..common import state as state_mod
+    from ..ops import fusion as fusion_mod
+    arrays = [_to_numpy(t) for t in tensors]
+    threshold = state_mod.global_state().config.fusion_threshold
+    buckets = fusion_mod.plan_buckets(arrays, threshold)
+    if name is None:
+        _grouped_counter[0] += 1
+        name = f"mxnet.grouped_allreduce.{_grouped_counter[0]}"
+    handles = []
+    for j, bucket in enumerate(buckets):
+        flats = [arrays[i].reshape(-1) for i in bucket.indices]
+        fused = flats[0] if len(flats) == 1 else np.concatenate(flats)
+        handles.append(_core.allreduce_async(
+            fused, average=average, name=f"{name}.bucket{j}",
+            kind="replicated"))
+    for bucket, handle in zip(buckets, handles):
+        fused = np.asarray(_core.synchronize(handle))
+        offset = 0
+        for i in bucket.indices:
+            n = arrays[i].size
+            _write_inplace(
+                tensors[i],
+                fused[offset:offset + n].reshape(arrays[i].shape))
+            offset += n
+    return tensors
+
+
+def allgather(tensor, name=None, priority=0):
+    """Concatenate every process's tensor along dim 0; first dims may
+    differ (reference mxnet/mpi_ops.py:122-156)."""
+    del priority
+    handle = _core.allgather_async(_to_numpy(tensor), name=name,
+                                   kind="replicated")
+    return _from_numpy(_core.synchronize(handle), tensor)
+
+
+def broadcast(tensor, root_rank=0, name=None, priority=0):
+    """Broadcast root's value into a new NDArray (reference
+    mxnet/mpi_ops.py:159-197)."""
+    del priority
+    handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                                   name=name, kind="replicated")
+    return _from_numpy(_core.synchronize(handle), tensor)
+
+
+def broadcast_(tensor, root_rank=0, name=None, priority=0):
+    """In-place broadcast (reference mxnet/mpi_ops.py:200-236)."""
+    del priority
+    handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                                   name=name, kind="replicated")
+    return _write_inplace(tensor, _core.synchronize(handle))

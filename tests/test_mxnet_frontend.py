@@ -1,0 +1,278 @@
+"""MXNet frontend: collectives on NDArrays, DistributedOptimizer update
+path, gluon DistributedTrainer grad exchange, broadcast_parameters with
+deferred init (reference test_mxnet.py patterns — single-process here, so
+process-level collectives are identity; the NDArray bridge, rescale_grad
+normalization and init hooks are what's under test).
+
+mxnet is not in the image, so a minimal numpy-backed stand-in is
+registered as ``mxnet`` — the frontend only relies on the NDArray duck
+type (asnumpy/__setitem__/dtype/wait_to_read, optional context) and the
+Optimizer/Trainer base-class contracts exercised below.
+"""
+
+import sys
+import types as _types
+
+import numpy as np
+import pytest
+
+
+def _install_fake_mxnet():
+    if "mxnet" in sys.modules:
+        return sys.modules["mxnet"]
+
+    class NDArray:
+        def __init__(self, data, ctx="cpu(0)", dtype=None):
+            self._data = np.array(data, dtype=dtype)
+            self.context = ctx
+
+        def asnumpy(self):
+            return self._data
+
+        def __setitem__(self, key, value):
+            self._data[key] = value
+
+        @property
+        def shape(self):
+            return self._data.shape
+
+        @property
+        def dtype(self):
+            return self._data.dtype
+
+        def wait_to_read(self):
+            pass
+
+    nd = _types.ModuleType("mxnet.nd")
+    nd.NDArray = NDArray
+    nd.array = lambda data, ctx="cpu(0)", dtype=None: NDArray(
+        data, ctx=ctx, dtype=dtype)
+    nd.zeros = lambda shape, ctx="cpu(0)", dtype=None: NDArray(
+        np.zeros(shape), ctx=ctx, dtype=dtype)
+
+    class Optimizer:
+        def __init__(self, learning_rate=0.1):
+            self.lr = learning_rate
+            self.rescale_grad = 1.0
+
+        def update(self, index, weight, grad, state):
+            weight[:] = (weight.asnumpy()
+                         - self.lr * self.rescale_grad * grad.asnumpy())
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self.update(index, weight, grad, state)
+
+        def create_state_multi_precision(self, index, weight):
+            return None
+
+        def set_learning_rate(self, lr):
+            self.lr = lr
+
+    optimizer = _types.ModuleType("mxnet.optimizer")
+    optimizer.Optimizer = Optimizer
+
+    class DeferredInitializationError(Exception):
+        pass
+
+    class Parameter:
+        def __init__(self, data=None, grad=None, grad_req="write"):
+            self._data = data
+            self._grad = grad
+            self.grad_req = grad_req
+
+        def data(self):
+            if self._data is None:
+                raise DeferredInitializationError()
+            return self._data
+
+        def list_grad(self):
+            return [self._grad]
+
+        def _init_impl(self, data):
+            self._data = data
+
+    class Trainer:
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     kvstore=None):
+            self._params = list(params.values()) if hasattr(params, "values") \
+                else list(params)
+            self._scale = 1.0
+            self._optimizer = optimizer
+
+        def step(self, batch_size):
+            self._allreduce_grads()
+
+    class ParameterDict(dict):
+        pass
+
+    parameter = _types.ModuleType("mxnet.gluon.parameter")
+    parameter.DeferredInitializationError = DeferredInitializationError
+    parameter.Parameter = Parameter
+    parameter.ParameterDict = ParameterDict
+
+    gluon = _types.ModuleType("mxnet.gluon")
+    gluon.Trainer = Trainer
+    gluon.parameter = parameter
+
+    mx = _types.ModuleType("mxnet")
+    mx.nd = nd
+    mx.optimizer = optimizer
+    mx.gluon = gluon
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = nd
+    sys.modules["mxnet.optimizer"] = optimizer
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.gluon.parameter"] = parameter
+    return mx
+
+
+@pytest.fixture
+def mx():
+    return _install_fake_mxnet()
+
+
+@pytest.fixture
+def mhvd(hvd, mx):
+    import horovod_tpu.mxnet as mhvd_mod
+    return mhvd_mod
+
+
+class TestMXNetOps:
+    def test_allreduce_identity_single_process(self, mx, mhvd):
+        x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = mhvd.allreduce(x, average=True)
+        assert out is not x
+        np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+
+    def test_allreduce_inplace(self, mx, mhvd):
+        x = mx.nd.array(3 * np.ones(4, np.float32))
+        out = mhvd.allreduce_(x, average=False)
+        assert out is x
+        np.testing.assert_allclose(x.asnumpy(), 3 * np.ones(4))
+
+    def test_grouped_allreduce_buckets_and_splits_back(self, mx, mhvd):
+        # mixed shapes + dtypes: buckets are dtype-homogeneous, results
+        # must land back in the right tensors with their original shapes
+        xs = [mx.nd.array(np.full((2, 3), 1.0, np.float32)),
+              mx.nd.array(np.full(5, 2.0, np.float32)),
+              mx.nd.array(np.full(4, 3.0, np.float64)),
+              mx.nd.array(np.full((3, 1), 4.0, np.float32))]
+        out = mhvd.grouped_allreduce_(xs, average=False, name="g",
+                                      priority=-1)
+        assert out is xs
+        np.testing.assert_allclose(xs[0].asnumpy(), np.full((2, 3), 1.0))
+        np.testing.assert_allclose(xs[1].asnumpy(), np.full(5, 2.0))
+        np.testing.assert_allclose(xs[2].asnumpy(), np.full(4, 3.0))
+        assert xs[2].dtype == np.float64
+        np.testing.assert_allclose(xs[3].asnumpy(), np.full((3, 1), 4.0))
+
+    def test_grouped_allreduce_respects_zero_threshold(self, mx, mhvd,
+                                                       monkeypatch):
+        from horovod_tpu.common import state as state_mod
+        monkeypatch.setattr(state_mod.global_state().config,
+                            "fusion_threshold", 0)
+        xs = [mx.nd.array(np.full(3, float(i))) for i in range(3)]
+        mhvd.grouped_allreduce_(xs, average=True)
+        for i, x in enumerate(xs):
+            np.testing.assert_allclose(x.asnumpy(), np.full(3, float(i)))
+
+    def test_allgather(self, mx, mhvd):
+        x = mx.nd.array(np.arange(4, dtype=np.float32).reshape(2, 2))
+        out = mhvd.allgather(x)
+        assert out.shape[0] == 2 * mhvd.process_count()
+
+    def test_broadcast_inplace(self, mx, mhvd):
+        x = mx.nd.array(np.random.RandomState(0).randn(5))
+        want = x.asnumpy().copy()
+        out = mhvd.broadcast_(x, root_rank=0)
+        assert out is x
+        np.testing.assert_allclose(x.asnumpy(), want)
+
+    def test_rejects_non_ndarray(self, mhvd):
+        with pytest.raises(ValueError, match="NDArray"):
+            mhvd.allreduce(np.ones(3))
+
+    def test_size_rank_are_process_level(self, mhvd):
+        assert mhvd.size() == mhvd.process_count()
+        assert mhvd.rank() == mhvd.process_rank()
+
+
+class TestDistributedOptimizer:
+    def test_rescale_grad_normalized(self, mx, mhvd):
+        opt = mx.optimizer.Optimizer()
+        dopt = mhvd.DistributedOptimizer(opt)
+        assert opt.rescale_grad == pytest.approx(1.0 / mhvd.size())
+        assert dopt.lr == opt.lr  # __getattr__ passthrough
+
+    def test_update_allreduces_then_updates(self, mx, mhvd):
+        opt = mx.optimizer.Optimizer(learning_rate=0.5)
+        dopt = mhvd.DistributedOptimizer(opt)
+        w = mx.nd.array(np.ones(3, np.float32))
+        g = mx.nd.array(2 * np.ones(3, np.float32))
+        dopt.update(0, w, g, None)
+        # single process: sum == identity; w -= lr * rescale * g
+        np.testing.assert_allclose(
+            w.asnumpy(), 1.0 - 0.5 * (1.0 / mhvd.size()) * 2.0)
+
+    def test_update_list_index_allreduces_each(self, mx, mhvd):
+        dopt = mhvd.DistributedOptimizer(mx.optimizer.Optimizer())
+        gs = [mx.nd.array(np.full(2, i + 1, np.float32)) for i in range(2)]
+        dopt._do_allreduce([10, 11], gs)
+        for i, g in enumerate(gs):
+            np.testing.assert_allclose(g.asnumpy(), np.full(2, i + 1))
+
+
+class TestDistributedTrainer:
+    def test_allreduce_grads_and_scale(self, mx, mhvd):
+        P = sys.modules["mxnet.gluon.parameter"].Parameter
+        params = {f"p{i}": P(data=mx.nd.array(np.ones(2)),
+                             grad=mx.nd.array(np.full(2, float(i))))
+                  for i in range(3)}
+        params["frozen"] = P(grad_req="null")
+        tr = mhvd.DistributedTrainer(params, mx.optimizer.Optimizer())
+        assert tr._scale == pytest.approx(1.0 / mhvd.size())
+        tr.step(1)
+        for i in range(3):
+            np.testing.assert_allclose(
+                params[f"p{i}"].list_grad()[0].asnumpy(), float(i))
+
+    def test_unwraps_distributed_optimizer(self, mx, mhvd):
+        inner = mx.optimizer.Optimizer()
+        with pytest.warns(UserWarning, match="unwrapped"):
+            tr = mhvd.DistributedTrainer({}, mhvd.DistributedOptimizer(inner))
+        assert tr._optimizer is inner
+
+
+class TestBroadcastParameters:
+    def test_dict_of_ndarrays(self, mx, mhvd):
+        params = {"a": mx.nd.array(np.ones(3)),
+                  "b": mx.nd.array(np.zeros(2))}
+        mhvd.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(params["a"].asnumpy(), np.ones(3))
+
+    def test_deferred_init_hooked(self, mx, mhvd):
+        P = sys.modules["mxnet.gluon.parameter"].Parameter
+        PD = mx.gluon.parameter.ParameterDict
+
+        ready = P(data=mx.nd.array(np.ones(2)))
+        deferred = P()  # no data yet -> DeferredInitializationError
+        params = PD(ready=ready, deferred=deferred)
+        mhvd.broadcast_parameters(params, root_rank=0)
+        # initializing the deferred param triggers the injected broadcast
+        deferred._init_impl(mx.nd.array(np.full(2, 7.0)))
+        np.testing.assert_allclose(deferred.data().asnumpy(), np.full(2, 7.0))
+
+    def test_plain_dict_of_parameters_mxnet2_style(self, mx, mhvd):
+        # MXNet 2.x collect_params() returns dict[str, Parameter]
+        P = sys.modules["mxnet.gluon.parameter"].Parameter
+        ready = P(data=mx.nd.array(np.full(2, 3.0)))
+        deferred = P()
+        params = {"ready": ready, "deferred": deferred}
+        mhvd.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(ready.data().asnumpy(), np.full(2, 3.0))
+        deferred._init_impl(mx.nd.array(np.full(2, 9.0)))
+        np.testing.assert_allclose(deferred.data().asnumpy(), np.full(2, 9.0))
+
+    def test_invalid_params_type(self, mhvd):
+        with pytest.raises(ValueError, match="invalid params"):
+            mhvd.broadcast_parameters([1, 2, 3])
