@@ -30,6 +30,9 @@ examples:
 	$(CPU_MESH) $(PY) examples/synthetic_benchmark.py --model resnet18 \
 	    --batch-size 1 --image-size 32 --num-warmup-batches 1 \
 	    --num-iters 1 --num-batches-per-iter 2
+	$(CPU_MESH) $(PY) examples/scaling_benchmark.py --model resnet18 \
+	    --batch-size 1 --image-size 32 --device-counts 1,2 \
+	    --num-warmup-batches 1 --num-iters 1 --num-batches-per-iter 2
 	$(CPU_ENV) $(PY) examples/pytorch_mnist.py \
 	    --epochs 1 --steps-per-epoch 4 --checkpoint-dir /tmp/hvd-ci-torch-ckpt
 	$(CPU_ENV) $(PY) examples/keras_mnist.py \

@@ -1,0 +1,85 @@
+"""Shared harness for the synthetic image benchmarks
+(synthetic_benchmark.py and scaling_benchmark.py): build a data-parallel
+train step over the current mesh and time it with the warmup + measured
+iterations protocol of the reference harness
+(examples/pytorch_synthetic_benchmark.py:24-33 — warmup batches, then
+num_iters x num_batches_per_iter timed batches)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import models, trainer
+
+
+def build_step(model_name, mesh, batch, image_size, fp16_allreduce=False):
+    """Compiled data-parallel train step + initial (params, opt_state,
+    batch data) for a zoo model on synthetic ImageNet-shaped data."""
+    kwargs = {"dropout_rate": 0.0} if model_name.startswith("vgg") else {}
+    model = models.build(model_name, num_classes=1000, dtype=jnp.bfloat16,
+                         **kwargs)
+    images = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})  # VGG has no BN
+
+    compression = (hvd.Compression.bf16 if fp16_allreduce
+                   else hvd.Compression.none)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  compression=compression)
+    opt_state = trainer.init_opt_state(tx, params, mesh)
+
+    def loss_fn(p, b):
+        imgs, lbls = b
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": batch_stats}, imgs, train=True,
+            mutable=["batch_stats"])
+        return trainer.softmax_cross_entropy(logits, lbls)
+
+    step = trainer.make_data_parallel_step(loss_fn, tx, mesh,
+                                           compression=compression,
+                                           donate=True)
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    images = jax.device_put(images, sharding)
+    labels = jax.device_put(labels, sharding)
+    return step, params, opt_state, (images, labels)
+
+
+def timed_rates(step, params, opt_state, batch_data, batch,
+                num_warmup_batches, num_iters, num_batches_per_iter,
+                on_iter=None):
+    """Run the reference timing protocol; returns per-iteration total
+    img/sec. The sync barrier is a scalar device-to-host read — on
+    remote-attached runtimes block_until_ready can return before
+    execution completes (docs/benchmarks.md)."""
+    loss = None
+    for _ in range(num_warmup_batches):
+        params, opt_state, loss = step(params, opt_state, batch_data)
+    if loss is not None:
+        float(loss)  # scalar transfer: a sync barrier on every backend
+
+    rates = []
+    for i in range(num_iters):
+        t0 = time.perf_counter()
+        for _ in range(num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state, batch_data)
+        float(loss)  # scalar transfer: a sync barrier on every backend
+        dt = time.perf_counter() - t0
+        rate = batch * num_batches_per_iter / dt
+        rates.append(rate)
+        if on_iter is not None:
+            on_iter(i, rate)
+    return rates
+
+
+def positive_int(value):
+    v = int(value)
+    if v < 1:
+        raise ValueError(f"expected a positive count, got {value}")
+    return v

@@ -10,18 +10,15 @@ single compiled train step.
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu import models, trainer
+from horovod_tpu import models
+
+from bench_common import build_step, positive_int, timed_rates
 
 
 def parse_args():
@@ -31,8 +28,8 @@ def parse_args():
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-worker batch size (reference default 32)")
     p.add_argument("--num-warmup-batches", type=int, default=10)
-    p.add_argument("--num-iters", type=int, default=10)
-    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=positive_int, default=10)
+    p.add_argument("--num-batches-per-iter", type=positive_int, default=10)
     p.add_argument("--image-size", type=int, default=None,
                    help="default: the model's canonical size (224; "
                         "inception3 299)")
@@ -50,57 +47,24 @@ def main():
     world = hvd.size()
     batch = args.batch_size * world
 
-    kwargs = {"dropout_rate": 0.0} if args.model.startswith("vgg") else {}
-    model = models.build(args.model, num_classes=1000, dtype=jnp.bfloat16,
-                         **kwargs)
-    images = jnp.zeros((batch, args.image_size, args.image_size, 3),
-                       jnp.bfloat16)
-    labels = jnp.zeros((batch,), jnp.int32)
-    variables = model.init(jax.random.PRNGKey(0), images[:2], train=False)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})  # VGG has no BN
-
-    compression = (hvd.Compression.bf16 if args.fp16_allreduce
-                   else hvd.Compression.none)
-    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
-                                  compression=compression)
-    opt_state = trainer.init_opt_state(tx, params, hvd.mesh())
-
-    def loss_fn(p, b):
-        imgs, lbls = b
-        logits, _ = model.apply(
-            {"params": p, "batch_stats": batch_stats}, imgs, train=True,
-            mutable=["batch_stats"])
-        return trainer.softmax_cross_entropy(logits, lbls)
-
-    step = trainer.make_data_parallel_step(loss_fn, tx, hvd.mesh(),
-                                           compression=compression,
-                                           donate=True)
-    sharding = NamedSharding(hvd.mesh(), P(hvd.mesh().axis_names[0]))
-    images = jax.device_put(images, sharding)
-    labels = jax.device_put(labels, sharding)
+    step, params, opt_state, batch_data = build_step(
+        args.model, hvd.mesh(), batch, args.image_size,
+        fp16_allreduce=args.fp16_allreduce)
 
     if hvd.process_rank() == 0:
         print(f"Model: {args.model}")
         print(f"Batch size: {args.batch_size} per worker x {world} workers")
 
-    for _ in range(args.num_warmup_batches):
-        params, opt_state, loss = step(params, opt_state, (images, labels))
-    float(loss)  # scalar transfer: a sync barrier on every backend
-
-    img_secs = []
-    for i in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            params, opt_state, loss = step(params, opt_state,
-                                           (images, labels))
-        float(loss)  # scalar transfer: a sync barrier on every backend
-        rate = batch * args.num_batches_per_iter / (time.perf_counter() - t0)
-        img_secs.append(rate / world)
+    def on_iter(i, rate):
         if hvd.process_rank() == 0:
             print(f"Iter #{i}: {rate / world:.1f} img/sec per worker")
 
+    rates = timed_rates(step, params, opt_state, batch_data, batch,
+                        args.num_warmup_batches, args.num_iters,
+                        args.num_batches_per_iter, on_iter=on_iter)
+
     if hvd.process_rank() == 0:
+        img_secs = [r / world for r in rates]
         mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
         print(f"Img/sec per worker: {mean:.1f} +-{conf:.1f}")
         print(f"Total img/sec on {world} worker(s): "
