@@ -7,59 +7,30 @@ single-GPU Pascal P100 ResNet-50 fp32 throughput (~219 img/sec) underlying
 the reference's 512-GPU scaling chart (docs/benchmarks.md:6-7) — the
 per-worker number our per-chip number must beat.
 
+The model/step recipe and warmup+timed-iteration protocol live in
+examples/bench_common.py, shared with examples/{synthetic,scaling}_benchmark
+so the harnesses cannot drift.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
+
 
 BASELINE_IMG_PER_SEC_PER_WORKER = 219.0  # P100 ResNet-50, reference baseline
-
-
-def _build(batch_per_chip, image_size, n_chips, mesh):
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import PartitionSpec as P
-
-    import horovod_tpu as hvd
-    from horovod_tpu import trainer
-    from horovod_tpu.models import resnet
-
-    batch = batch_per_chip * n_chips
-    model = resnet.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-    rng = jax.random.PRNGKey(0)
-    images = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
-    labels = jnp.zeros((batch,), jnp.int32)
-    variables = model.init(rng, images[:2], train=False)
-    params, batch_stats = variables["params"], variables["batch_stats"]
-
-    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
-    opt_state = trainer.init_opt_state(tx, params, mesh)
-
-    def loss_fn(p, batch_data):
-        imgs, lbls = batch_data
-        logits, _ = model.apply(
-            {"params": p, "batch_stats": batch_stats}, imgs, train=True,
-            mutable=["batch_stats"])
-        return trainer.softmax_cross_entropy(logits, lbls)
-
-    step = trainer.make_data_parallel_step(loss_fn, tx, mesh, donate=True)
-    data_sharding = jax.sharding.NamedSharding(mesh, P(mesh.axis_names[0]))
-    images = jax.device_put(images, data_sharding)
-    labels = jax.device_put(labels, data_sharding)
-    return step, params, opt_state, images, labels
 
 
 def main():
     import jax
 
     import horovod_tpu as hvd
+    from bench_common import build_step, timed_rates
 
     hvd.init()
     n_chips = hvd.size()
@@ -73,46 +44,28 @@ def main():
     env_batch = os.environ.get("HVD_BENCH_BATCH")
     candidates = ([int(env_batch)] if env_batch else
                   [256, 128, 64] if on_tpu else [4])
+    warmup, iters, inner = (3, 10, 10) if on_tpu else (2, 3, 3)
 
-    step = params = opt_state = images = labels = None
-    batch_per_chip = candidates[-1]
+    rates = None
+    batch = candidates[-1] * n_chips
     for cand in candidates:
+        batch = cand * n_chips
         try:
-            step, params, opt_state, images, labels = _build(
-                cand, image_size, n_chips, mesh)
-            params, opt_state, loss = step(params, opt_state,
-                                           (images, labels))
-            float(loss)  # scalar transfer: a sync barrier on every backend
-            batch_per_chip = cand
+            step, params, opt_state, batch_data = build_step(
+                "resnet50", mesh, batch, image_size)
+            rates = timed_rates(step, params, opt_state, batch_data, batch,
+                                warmup, iters, inner)
             break
         except Exception as e:  # noqa: BLE001 — OOM fallback
             if cand == candidates[-1] or "RESOURCE_EXHAUSTED" not in str(e):
                 raise
             # release the failed candidate's arrays/executable before
             # building the smaller one, or the retry inherits its memory
-            step = params = opt_state = images = labels = None
+            step = params = opt_state = batch_data = None
             jax.clear_caches()
             print(f"batch {cand}/chip OOM, trying smaller", file=sys.stderr)
-    batch = batch_per_chip * n_chips
 
-    # warmup (reference: 10 warmup batches; first step above compiled)
-    for _ in range(3 if on_tpu else 2):
-        params, opt_state, loss = step(params, opt_state, (images, labels))
-    float(loss)  # scalar transfer: a sync barrier on every backend
-
-    iters, inner = (10, 10) if on_tpu else (3, 3)
-    rates = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        for _ in range(inner):
-            params, opt_state, loss = step(params, opt_state,
-                                           (images, labels))
-        float(loss)  # scalar transfer: a sync barrier on every backend
-        dt = time.perf_counter() - t0
-        rates.append(batch * inner / dt)
-
-    img_sec = float(np.mean(rates))
-    img_sec_per_chip = img_sec / n_chips
+    img_sec_per_chip = float(np.mean(rates)) / n_chips
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_sec_per_chip, 2),

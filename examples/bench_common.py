@@ -9,7 +9,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -55,14 +54,15 @@ def timed_rates(step, params, opt_state, batch_data, batch,
                 num_warmup_batches, num_iters, num_batches_per_iter,
                 on_iter=None):
     """Run the reference timing protocol; returns per-iteration total
-    img/sec. The sync barrier is a scalar device-to-host read — on
-    remote-attached runtimes block_until_ready can return before
-    execution completes (docs/benchmarks.md)."""
-    loss = None
-    for _ in range(num_warmup_batches):
+    img/sec. At least one warmup step always runs so trace+compile of the
+    jitted step can never land inside the timed region (a compile-polluted
+    first iteration would silently wreck the reported rate). The sync
+    barrier is a scalar device-to-host read — on remote-attached runtimes
+    block_until_ready can return before execution completes
+    (docs/benchmarks.md)."""
+    for _ in range(max(1, num_warmup_batches)):
         params, opt_state, loss = step(params, opt_state, batch_data)
-    if loss is not None:
-        float(loss)  # scalar transfer: a sync barrier on every backend
+    float(loss)  # scalar transfer: a sync barrier on every backend
 
     rates = []
     for i in range(num_iters):

@@ -64,7 +64,11 @@ def main():
     args = parse_args()
     n_avail = len(jax.devices())
     if args.device_counts:
-        counts = sorted({int(c) for c in args.device_counts.split(",")})
+        try:
+            counts = sorted({positive_int(c)
+                             for c in args.device_counts.split(",")})
+        except ValueError as e:
+            raise SystemExit(f"--device-counts: {e}")
         bad = [c for c in counts if c > n_avail]
         if bad:
             raise SystemExit(f"asked for {bad} devices, have {n_avail}")

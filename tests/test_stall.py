@@ -1,0 +1,120 @@
+"""End-to-end stall detection through the public API (reference
+test/test_stall.py: ranks sleeping past HOROVOD_STALL_CHECK_TIME_SECONDS
+trigger the warning, HOROVOD_STALL_SHUTDOWN_TIME_SECONDS the hard
+shutdown). Single process here, so a "stall" is an enqueued collective
+whose flush is held back — the detection deadlines, the warning text and
+the StalledError/ShutdownError surfaces are what's under test."""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def hvd_stall(monkeypatch):
+    """Initialized with tiny stall deadlines via the reference's env knobs
+    (operations.cc:998-1002)."""
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.15")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.8")
+    import horovod_tpu as hvd_mod
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+def _coord():
+    import horovod_tpu
+    return horovod_tpu.common.state.global_state().coordinator
+
+
+@pytest.fixture
+def hvd_log(caplog):
+    """The package logger does not propagate to root (it mirrors the
+    reference's standalone C++ logger), so caplog's root handler must be
+    attached to it directly."""
+    from horovod_tpu.common import hvd_logging
+    logger = hvd_logging.get_logger()
+    logger.addHandler(caplog.handler)
+    yield caplog
+    logger.removeHandler(caplog.handler)
+
+
+class TestStall:
+    def test_warning_after_check_time(self, hvd_stall, hvd_log):
+        coord = _coord()
+        coord._paused = True  # hold the flush: the collective stalls
+        try:
+            h = hvd_stall.allreduce_async(np.ones((8, 2)), name="slow")
+            time.sleep(0.3)
+            with hvd_log.at_level(logging.WARNING):
+                coord._check_stalled()
+            assert any("waiting for" in r.getMessage()
+                       and "slow" in r.getMessage()
+                       for r in hvd_log.records), hvd_log.records
+            # warned, not killed: releasing the flush completes it
+            coord._paused = False
+            out = hvd_stall.synchronize(h)
+            np.testing.assert_allclose(np.asarray(out), np.ones((8, 2)))
+        finally:
+            coord._paused = False
+
+    def test_warning_emitted_once_per_tensor(self, hvd_stall, hvd_log):
+        coord = _coord()
+        coord._paused = True
+        try:
+            h = hvd_stall.allreduce_async(np.ones((8, 1)), name="once")
+            time.sleep(0.3)
+            with hvd_log.at_level(logging.WARNING):
+                coord._check_stalled()
+                coord._check_stalled()
+            hits = [r for r in hvd_log.records if "once" in r.getMessage()]
+            assert len(hits) == 1, hits
+            coord._paused = False
+            hvd_stall.synchronize(h)
+        finally:
+            coord._paused = False
+
+    def test_synchronize_raises_after_shutdown_deadline(self, hvd_stall):
+        coord = _coord()
+        coord._paused = True  # flush never runs: synchronize must not hang
+        try:
+            h = hvd_stall.allreduce_async(np.ones((8, 1)), name="dead")
+            with pytest.raises(hvd_stall.StalledError, match="dead"):
+                hvd_stall.synchronize(h)
+        finally:
+            coord._paused = False
+
+    def test_background_kill_marks_entry_stalled(self, hvd_stall):
+        """The background cycle's hard-shutdown path (reference
+        InvalidateStalledCachedTensors + shutdown,
+        operations.cc:688-786): past the deadline the entry completes
+        with StalledError and leaves the table."""
+        coord = _coord()
+        coord._paused = True
+        try:
+            h = hvd_stall.allreduce_async(np.ones((8, 1)), name="killed")
+            time.sleep(0.9)
+            coord._check_stalled()
+            assert "killed" not in coord._tensor_table
+            with pytest.raises(hvd_stall.StalledError):
+                hvd_stall.synchronize(h)
+        finally:
+            coord._paused = False
+
+    def test_shutdown_fails_pending_handles(self, hvd_stall):
+        """SHUT_DOWN_ERROR propagation to outstanding callbacks
+        (operations.cc:1107-1122)."""
+        coord = _coord()
+        coord._paused = True
+        h = hvd_stall.allreduce_async(np.ones((8, 1)), name="pending")
+        hvd_stall.shutdown()
+        # after shutdown the public API refuses outright; the pending
+        # entry itself carries the shutdown error (via the retained
+        # coordinator, whose handle table survives for exactly this)
+        with pytest.raises((hvd_stall.ShutdownError,
+                            hvd_stall.NotInitializedError)):
+            hvd_stall.synchronize(h)
+        with pytest.raises(hvd_stall.ShutdownError):
+            coord.synchronize(h)
