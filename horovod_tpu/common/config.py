@@ -75,6 +75,12 @@ class HorovodConfig:
     # Autotuning of fusion_threshold / cycle_time.
     autotune: bool = False
     autotune_log: str = ""
+    # Multi-process autotune: tuned values are adopted by every process at
+    # the same point in the replicated-collective order, synced via a tiny
+    # allgather every this-many replicated collectives (the role of the
+    # reference coordinator's parameter broadcast,
+    # parameter_manager.cc:66-81).
+    autotune_sync_collectives: int = 32
     # Hierarchical (two-level ICI/DCN) collectives.
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
@@ -99,6 +105,8 @@ class HorovodConfig:
                 "STALL_SHUTDOWN_TIME_SECONDS", 0.0),
             autotune=env_bool("AUTOTUNE", False),
             autotune_log=env_str("AUTOTUNE_LOG", "") or "",
+            autotune_sync_collectives=env_int("AUTOTUNE_SYNC_COLLECTIVES",
+                                              32),
             hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER", False),
             ring_allreduce=env_bool("RING_ALLREDUCE", False),

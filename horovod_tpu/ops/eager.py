@@ -192,22 +192,28 @@ class EagerCoordinator:
         self.timeline = timeline_mod.create_from_env(
             self._config, jax.process_index() == 0)
         self.autotuner = None
-        if self._config.autotune:
-            if jax.process_count() > 1:
-                # Per-process tuning would diverge the fusion plans across
-                # processes — multi-controller SPMD needs identical
-                # collective order everywhere. Until tuned values flow
-                # through the coordination service, autotune is single-
-                # process only (the reference broadcasts tuned params from
-                # the coordinator for the same reason,
-                # parameter_manager.cc:66-81).
-                log.warning("HOROVOD_AUTOTUNE is single-process only for "
-                            "now; disabling on this %d-process run.",
-                            jax.process_count())
-            else:
-                from ..utils import autotune as autotune_mod
-                self.autotuner = autotune_mod.Autotuner(
-                    self._config, log_path=self._config.autotune_log or None)
+        # Multi-process: per-process tuning would diverge the fusion plans
+        # across processes (multi-controller SPMD needs identical
+        # collective order everywhere), so only process 0 measures+tunes
+        # and every process — including 0 — adopts tuned values at the
+        # same agreed point in the replicated-collective order via
+        # _sync_tuned_params (the reference coordinator's parameter
+        # broadcast, parameter_manager.cc:66-81).
+        self._autotune_defer = (self._config.autotune and
+                                jax.process_count() > 1)
+        self._autotune_sync_every = (
+            max(1, self._config.autotune_sync_collectives)
+            if self._autotune_defer else 0)
+        self._replicated_count = 0
+        self._proposed_params = None
+        # True between staging a suggestion and its adoption at the sync
+        # point: measurement pauses in that window, or cycles run under
+        # the OLD config would be scored against the NEW knobs
+        self._autotune_pending_adoption = False
+        if self._config.autotune and (jax.process_index() == 0):
+            from ..utils import autotune as autotune_mod
+            self.autotuner = autotune_mod.Autotuner(
+                self._config, log_path=self._config.autotune_log or None)
         self._thread = threading.Thread(
             target=self._background_loop, daemon=True, name="hvd-background")
         self._thread.start()
@@ -324,7 +330,7 @@ class EagerCoordinator:
             plan = self._make_plan(batch)
             self.plan_cache.put(key, plan)
         self._execute(batch, plan)
-        if self.autotuner is not None:
+        if self.autotuner is not None and not self._autotune_pending_adoption:
             # JAX dispatch is async: without blocking, t1-t0 measures
             # host dispatch, not collective throughput, and the GP would
             # tune noise. Only the tuning path pays this sync.
@@ -338,11 +344,19 @@ class EagerCoordinator:
             total = sum(_entry_nbytes(e) for e in batch)
             if self.autotuner.record_cycle(total,
                                            time.perf_counter() - t0):
-                # apply the next suggestion (ParameterManager::Tune)
-                self._config.fusion_threshold = int(
-                    self.autotuner.threshold)
-                self._config.cycle_time_ms = float(
-                    self.autotuner.cycle_time_ms)
+                if self._autotune_defer:
+                    # multi-process: don't apply locally — stage the
+                    # suggestion for the next agreed sync point, or the
+                    # processes' fusion plans would diverge mid-stream
+                    self._proposed_params = (self.autotuner.threshold,
+                                             self.autotuner.cycle_time_ms)
+                    self._autotune_pending_adoption = True
+                else:
+                    # apply the next suggestion (ParameterManager::Tune)
+                    self._config.fusion_threshold = int(
+                        self.autotuner.threshold)
+                    self._config.cycle_time_ms = float(
+                        self.autotuner.cycle_time_ms)
 
     def _make_plan(self, batch):
         """Group fusable entries (stacked allreduces by dtype/average), one
@@ -475,6 +489,16 @@ class EagerCoordinator:
         tl = self.timeline
         if tl:
             tl.start_activity(entry.name, op.upper())
+        # Count replicated executions BEFORE running the op, and sync
+        # tuned params in the finally: every process executes the same
+        # replicated ops in the same program order (and error paths —
+        # verification mismatches — raise on all processes alike), so the
+        # counter and therefore the sync schedule stay in lockstep.
+        sync_params = False
+        if self._autotune_sync_every and entry_kind == "replicated":
+            self._replicated_count += 1
+            sync_params = (
+                self._replicated_count % self._autotune_sync_every == 0)
         try:
             # Verify on the FIRST occurrence of each collective SIGNATURE
             # (op/dtype/shape/root — not name: auto-generated names are
@@ -506,8 +530,37 @@ class EagerCoordinator:
             else:
                 raise ValueError(f"Unknown op {op}")
         finally:
+            if sync_params:
+                self._sync_tuned_params()
             if tl:
                 tl.end_activity(entry.name)
+
+    def _sync_tuned_params(self):
+        """Adopt process 0's (possibly staged) tuned parameters on every
+        process, at this agreed point in the replicated-collective order —
+        the reference coordinator's parameter broadcast over a custom MPI
+        struct (parameter_manager.cc:66-81). A fixed-size int32 allgather:
+        EVERY process must reach it (no locally-decided skips), which the
+        count-scheduled call site guarantees."""
+        from jax.experimental import multihost_utils
+        if self._proposed_params is not None:
+            thr, ct = self._proposed_params
+        else:
+            thr, ct = (self._config.fusion_threshold,
+                       self._config.cycle_time_ms)
+        # int32 triple [threshold-hi, threshold-lo, cycle time µs]: exact
+        # through the wire (jax without x64 would truncate int64/float64;
+        # a single int32 would overflow for thresholds >= 2 GiB)
+        thr_hi, thr_lo = divmod(int(thr), 1 << 31)
+        mine = np.array([thr_hi, thr_lo, int(ct * 1000)], np.int32)
+        gathered = np.asarray(multihost_utils.process_allgather(mine))
+        if gathered.ndim == 1:  # single process: allgather returns [3]
+            gathered = gathered[None, :]
+        self._config.fusion_threshold = (
+            (int(gathered[0, 0]) << 31) + int(gathered[0, 1]))
+        self._config.cycle_time_ms = float(gathered[0, 2]) / 1000.0
+        self._proposed_params = None
+        self._autotune_pending_adoption = False
 
     _META_DIMS = 10
 

@@ -1,0 +1,101 @@
+"""Multi-process autotune: process 0 tunes, every process adopts the
+tuned fusion-threshold/cycle-time at the same agreed point in the
+replicated-collective order (the reference coordinator's parameter
+broadcast, parameter_manager.cc:66-81; scheduling via
+HOROVOD_AUTOTUNE_SYNC_COLLECTIVES)."""
+
+import numpy as np
+
+from horovod_tpu.run.launch import run
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    "HOROVOD_AUTOTUNE": "1",
+    "HOROVOD_AUTOTUNE_SYNC_COLLECTIVES": "4",
+}
+
+
+class TestAutotuneSync:
+    def test_processes_adopt_identical_tuned_params(self):
+        def fn():
+            import numpy as np
+            import horovod_tpu as hvd
+            # one suggestion per flush cycle, so tuning definitely moves
+            # the knobs within a short run
+            from horovod_tpu.utils import autotune as at
+            at.CYCLES_PER_SAMPLE = 1
+            at.SAMPLES_PER_STEP = 1
+            hvd.init()
+            for i in range(9):
+                hvd.allreduce(np.ones((4,), np.float32), name=f"t{i}",
+                              average=False)
+            from horovod_tpu.common import state
+            cfg = state.global_state().config
+            out = (int(cfg.fusion_threshold),
+                   round(float(cfg.cycle_time_ms), 3))
+            hvd.shutdown()
+            return out
+
+        results = run(fn, num_proc=2, env=_ENV)
+        assert results[0] == results[1], results
+        # the tuner moved the knobs off the defaults by the time the 8th
+        # replicated collective synced them (suggestions land each cycle)
+        assert results[0] != (64 * 1024 * 1024, 5.0), results
+
+    def test_results_stay_correct_while_tuning(self):
+        def fn():
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.utils import autotune as at
+            at.CYCLES_PER_SAMPLE = 1
+            at.SAMPLES_PER_STEP = 1
+            hvd.init()
+            vals = []
+            for i in range(10):
+                out = hvd.allreduce(np.full((3,), float(i), np.float32),
+                                    average=False, name=f"v{i}")
+                vals.append(float(np.asarray(out)[0]))
+            hvd.shutdown()
+            return vals
+
+        results = run(fn, num_proc=2, env=_ENV)
+        want = [2.0 * i for i in range(10)]
+        assert results[0] == want and results[1] == want, results
+
+
+class TestSyncUnit:
+    def test_sync_applies_row0(self, hvd):
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord._proposed_params = (123456.0, 7.5)
+        coord._sync_tuned_params()
+        cfg = horovod_tpu.common.state.global_state().config
+        assert cfg.fusion_threshold == 123456
+        assert cfg.cycle_time_ms == 7.5
+        assert coord._proposed_params is None
+
+    def test_sync_roundtrips_large_threshold(self, hvd):
+        # thresholds >= 2 GiB must survive the int32 wire format exactly
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord._proposed_params = (float(3 * 1024 ** 3 + 12345), 2.0)
+        coord._sync_tuned_params()
+        cfg = horovod_tpu.common.state.global_state().config
+        assert cfg.fusion_threshold == 3 * 1024 ** 3 + 12345
+
+    def test_sync_clears_pending_adoption(self, hvd):
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord._proposed_params = (1024.0, 3.0)
+        coord._autotune_pending_adoption = True
+        coord._sync_tuned_params()
+        assert coord._autotune_pending_adoption is False
+
+    def test_sync_without_proposal_keeps_current(self, hvd):
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        cfg = horovod_tpu.common.state.global_state().config
+        before = (cfg.fusion_threshold, cfg.cycle_time_ms)
+        coord._sync_tuned_params()
+        assert (cfg.fusion_threshold, cfg.cycle_time_ms) == before
