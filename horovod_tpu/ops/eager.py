@@ -201,11 +201,21 @@ class EagerCoordinator:
         # broadcast, parameter_manager.cc:66-81).
         self._autotune_defer = (self._config.autotune and
                                 jax.process_count() > 1)
+        if (self._autotune_defer and
+                self._config.autotune_sync_collectives <= 0):
+            raise ValueError(
+                "HOROVOD_AUTOTUNE_SYNC_COLLECTIVES must be >= 1 (got "
+                f"{self._config.autotune_sync_collectives}); a non-positive "
+                "interval would silently sync on every collective — to "
+                "disable autotuning, unset HOROVOD_AUTOTUNE instead")
         self._autotune_sync_every = (
-            max(1, self._config.autotune_sync_collectives)
+            self._config.autotune_sync_collectives
             if self._autotune_defer else 0)
         self._replicated_count = 0
         self._proposed_params = None
+        # set by _sync_tuned_params: the adoption flush must not be scored
+        # (it ran under the old plan and paid the sync-allgather latency)
+        self._adopted_this_flush = False
         # True between staging a suggestion and its adoption at the sync
         # point: measurement pauses in that window, or cycles run under
         # the OLD config would be scored against the NEW knobs
@@ -329,8 +339,14 @@ class EagerCoordinator:
         if plan is None:
             plan = self._make_plan(batch)
             self.plan_cache.put(key, plan)
+        self._adopted_this_flush = False
         self._execute(batch, plan)
-        if self.autotuner is not None and not self._autotune_pending_adoption:
+        # adoption during this flush also skips scoring: that cycle ran
+        # under the old plan and paid the sync-allgather latency, so it
+        # belongs to neither knob setting
+        if (self.autotuner is not None
+                and not self._autotune_pending_adoption
+                and not self._adopted_this_flush):
             # JAX dispatch is async: without blocking, t1-t0 measures
             # host dispatch, not collective throughput, and the GP would
             # tune noise. Only the tuning path pays this sync.
@@ -561,6 +577,7 @@ class EagerCoordinator:
         self._config.cycle_time_ms = float(gathered[0, 2]) / 1000.0
         self._proposed_params = None
         self._autotune_pending_adoption = False
+        self._adopted_this_flush = True
 
     _META_DIMS = 10
 

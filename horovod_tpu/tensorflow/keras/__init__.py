@@ -1,0 +1,17 @@
+"""tf.keras wrapper — the reference ships the Keras adapters twice, once
+for standalone Keras (`horovod/keras/__init__.py`) and once under the TF
+namespace (`horovod/tensorflow/keras/__init__.py`), both thin wrappers
+over the shared `horovod/_keras/` impl. Keras 3 has a single distribution
+again, so this package re-exports `horovod_tpu.keras` verbatim to keep
+reference import paths working:
+
+    import horovod_tpu.tensorflow.keras as hvd
+"""
+
+from ...keras import *  # noqa: F401,F403
+from ...keras import callbacks  # noqa: F401
+from ...keras import (  # noqa: F401  — names the star-import may skip
+    broadcast_global_variables, load_model, DistributedOptimizer,
+    init, shutdown, is_initialized, mpi_threads_supported,
+    size, local_size, rank, local_rank, process_rank, process_count,
+    allreduce, allgather, broadcast, Compression)

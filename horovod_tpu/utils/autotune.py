@@ -10,9 +10,12 @@ native core (_native/src/autotune.cc); a pure-Python random-search fallback
 keeps autotuning available without the toolchain.
 
 Where the reference's coordinator broadcasts tuned values over a custom MPI
-struct (parameter_manager.cc:66-81), the single-controller design needs no
-broadcast: every process tunes deterministically from identical
-measurements, or rank 0's values flow through broadcast_object.
+struct (parameter_manager.cc:66-81), multi-process runs here have ONLY
+process 0 tune (per-process tuning from local timings would diverge the
+fusion plans), and every process adopts the tuned values at the same agreed
+point in the replicated-collective order — EagerCoordinator's
+_sync_tuned_params allgather, scheduled every
+HOROVOD_AUTOTUNE_SYNC_COLLECTIVES collectives.
 """
 
 import ctypes

@@ -92,6 +92,15 @@ class TestSyncUnit:
         coord._sync_tuned_params()
         assert coord._autotune_pending_adoption is False
 
+    def test_sync_marks_adoption_flush(self, hvd):
+        # the adoption flush must be excluded from autotune scoring
+        import horovod_tpu
+        coord = horovod_tpu.common.state.global_state().coordinator
+        coord._adopted_this_flush = False
+        coord._proposed_params = (2048.0, 4.0)
+        coord._sync_tuned_params()
+        assert coord._adopted_this_flush is True
+
     def test_sync_without_proposal_keeps_current(self, hvd):
         import horovod_tpu
         coord = horovod_tpu.common.state.global_state().coordinator

@@ -152,3 +152,17 @@ class TestKerasFrontend:
         loaded = khvd.load_model(path)
         assert type(loaded.optimizer).__name__ == "SGD"
         assert hasattr(loaded.optimizer, "_hvd_compression")
+
+
+class TestTfKerasNamespace:
+    def test_tf_keras_wrapper_mirrors_keras(self, hvd):
+        """The reference exposes the Keras adapters under both
+        horovod.keras and horovod.tensorflow.keras; same here."""
+        import horovod_tpu.keras as k
+        import horovod_tpu.tensorflow.keras as tfk
+        assert tfk.DistributedOptimizer is k.DistributedOptimizer
+        assert tfk.load_model is k.load_model
+        assert (tfk.broadcast_global_variables
+                is k.broadcast_global_variables)
+        assert tfk.callbacks is k.callbacks
+        assert tfk.size is k.size and tfk.rank is k.rank

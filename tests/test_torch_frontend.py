@@ -161,6 +161,44 @@ class TestTorchDistributedOptimizer:
         opt.step()
         assert not opt._handles and not opt._passes
 
+    def test_model_parallelism_skips_local_params(self, thvd):
+        """Params kept out of the optimizer (model-parallel: each worker
+        owns them locally) must never be allreduced (reference
+        test_torch.py:1119 test_model_parallelism)."""
+        model = torch.nn.Sequential(torch.nn.Linear(4, 3),
+                                    torch.nn.Linear(3, 1))
+        shared = list(model[0].parameters())
+        local = list(model[1].parameters())
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(shared, lr=0.01),
+            named_parameters=[(f"s{i}", p) for i, p in enumerate(shared)])
+        opt._register_hooks()  # force hooks even at size()==1
+        torch.nn.functional.mse_loss(
+            model(torch.randn(8, 4)), torch.randn(8, 1)).backward()
+        assert all(p in opt._passes for p in shared)
+        assert all(p not in opt._passes and p not in opt._handles
+                   for p in local)
+        opt.step()
+
+    def test_dynamic_requires_grad(self, thvd):
+        """Freezing a param between steps must not break the hook-driven
+        window (reference test_torch.py:1177 dynamic requires_grad)."""
+        model = self._model()
+        params = list(model.parameters())
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(params, lr=0.01),
+            named_parameters=model.named_parameters())
+        opt._register_hooks()
+        X, Y = torch.randn(8, 4), torch.randn(8, 1)
+        torch.nn.functional.mse_loss(model(X), Y).backward()
+        opt.step()
+        opt.zero_grad()
+        frozen = params[0]
+        frozen.requires_grad_(False)
+        torch.nn.functional.mse_loss(model(X), Y).backward()
+        assert frozen not in opt._handles
+        opt.step()  # must not raise with the frozen param's stale window
+
     def test_broadcast_optimizer_state(self, thvd):
         model = self._model()
         base = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
