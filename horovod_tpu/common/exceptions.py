@@ -29,11 +29,14 @@ class ShutdownError(HorovodError):
     Parity: SHUT_DOWN_ERROR (reference horovod/common/operations.cc:114-118).
     """
 
-    def __init__(self):
-        super().__init__(
+    def __init__(self, reason=None):
+        msg = (
             "Horovod has been shut down. This was caused by an exception on "
             "one of the ranks or an attempt to submit a collective after "
             "shutdown() was called.")
+        if reason:
+            msg += f" ({reason})"
+        super().__init__(msg)
 
 
 class DuplicateNameError(HorovodError):

@@ -114,6 +114,24 @@ def grouped_allreduce_(tensors, average=True, name=None, priority=0):
     from ..common import state as state_mod
     from ..ops import fusion as fusion_mod
     arrays = [_to_numpy(t) for t in tensors]
+    coord = state_mod.global_state().coordinator
+    if getattr(coord, "_negotiator", None) is not None:
+        # negotiated multi-process: submit tensors individually — the
+        # rank-0 coordinator fuses ready allreduces centrally
+        # (client-side bucketing would have to agree on the threshold
+        # across processes; the coordinator's single decision point
+        # doesn't). The non-negotiated fallback keeps client bucketing:
+        # its strict same-order contract covers the threshold too.
+        if name is None:
+            _grouped_counter[0] += 1
+            name = f"mxnet.grouped_allreduce.{_grouped_counter[0]}"
+        handles = [
+            _core.allreduce_async(arr, average=average, name=f"{name}.{i}",
+                                  kind="replicated")
+            for i, arr in enumerate(arrays)]
+        for tensor, handle in zip(tensors, handles):
+            _write_inplace(tensor, _core.synchronize(handle))
+        return tensors
     threshold = state_mod.global_state().config.fusion_threshold
     buckets = fusion_mod.plan_buckets(arrays, threshold)
     if name is None:

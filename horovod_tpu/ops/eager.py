@@ -191,16 +191,46 @@ class EagerCoordinator:
         self._verified_sigs = set()  # cross-process checks done (signature)
         self.timeline = timeline_mod.create_from_env(
             self._config, jax.process_index() == 0)
+        # Multi-process control plane: rank-0 coordinator negotiation over
+        # the launch layer's TCP protocol (ops/negotiation.py — the
+        # reference's Request/Response protocol, operations.cc:1217-1245).
+        # With it, processes may submit collectives in any order; without
+        # a resolvable control address, fall back to the strict
+        # same-program-order contract with cross-process checking.
+        self._negotiator = None
+        self._negotiated_pending = {}  # name -> entry awaiting a response
+        self._applied_seq = -1
+        self._cycle_failures = 0
+        self._unannounced = []  # metas not yet delivered to the coordinator
+        if jax.process_count() > 1:
+            from . import negotiation as neg
+            addrs = neg.control_addresses()
+            key = neg.control_key()
+            if addrs is None or key is None:
+                missing = ("HVD_CONTROL_ADDR/HVD_COORDINATOR_ADDR"
+                           if addrs is None else "HVD_SECRET_KEY")
+                log.warning(
+                    "no %s; the multi-process eager API runs WITHOUT "
+                    "rank-0 negotiation — every process must submit "
+                    "collectives in the same order", missing)
+            else:
+                self._negotiator = neg.NegotiationWorker(
+                    jax.process_index(), jax.process_count(),
+                    self._config, addrs, key)
         self.autotuner = None
-        # Multi-process: per-process tuning would diverge the fusion plans
-        # across processes (multi-controller SPMD needs identical
-        # collective order everywhere), so only process 0 measures+tunes
-        # and every process — including 0 — adopts tuned values at the
-        # same agreed point in the replicated-collective order via
-        # _sync_tuned_params (the reference coordinator's parameter
-        # broadcast, parameter_manager.cc:66-81).
+        # Multi-process without negotiation: per-process tuning would
+        # diverge the fusion plans across processes (multi-controller SPMD
+        # needs identical collective order everywhere), so only process 0
+        # measures+tunes and every process — including 0 — adopts tuned
+        # values at the same agreed point in the replicated-collective
+        # order via _sync_tuned_params (the reference coordinator's
+        # parameter broadcast, parameter_manager.cc:66-81). Under
+        # negotiation none of that is needed: fusion happens at the
+        # coordinator with rank 0's live config, and tuned values ride
+        # every CycleResponse for the other processes to mirror.
         self._autotune_defer = (self._config.autotune and
-                                jax.process_count() > 1)
+                                jax.process_count() > 1 and
+                                self._negotiator is None)
         if (self._autotune_defer and
                 self._config.autotune_sync_collectives <= 0):
             raise ValueError(
@@ -283,10 +313,13 @@ class EagerCoordinator:
             deadline = (entry.enqueue_time +
                         self._config.stall_shutdown_time_seconds)
         while not entry.event.is_set():
-            if not self._paused:
+            if not self._paused and self._negotiator is None:
                 # non-blocking: if another thread's flush is stuck inside a
                 # hung transport collective, waiting on its lock here would
-                # also swallow the stall deadline below
+                # also swallow the stall deadline below. Under negotiation
+                # ONLY the background thread may run the cycle — a
+                # user-thread flush would break the single-origin ordering
+                # of data-plane collectives.
                 self.flush(blocking=False)
             if entry.event.wait(timeout=self._config.cycle_time_ms / 1000.0):
                 break
@@ -322,6 +355,9 @@ class EagerCoordinator:
             self._flush_lock.release()
 
     def _flush_locked(self):
+        if self._negotiator is not None:
+            self._negotiated_flush_locked()
+            return
         with self._queue_lock:
             batch = list(self._queue)
             self._queue.clear()
@@ -421,6 +457,189 @@ class EagerCoordinator:
                     for e in entries:
                         self._tensor_table.pop(e.name, None)
                         e.event.set()
+
+    # -- negotiated multi-process cycle (RunLoopOnce's coordinator
+    # protocol, operations.cc:1246-1551, over the TCP control plane) --
+
+    def _negotiated_flush_locked(self):
+        """One negotiation round: announce newly queued entries, apply
+        every response the coordinator has ordered since our last ack.
+        Runs ONLY on the background thread — all data-plane collectives
+        originate here, in response-seq order, so they match across
+        processes no matter how entries were submitted."""
+        from . import negotiation as neg
+        with self._queue_lock:
+            batch = list(self._queue)
+            self._queue.clear()
+        if self.timeline and batch:
+            self.timeline.mark_cycle_start()
+        # announcements survive transient control-plane failures: a meta
+        # dropped on a TCP hiccup would never be resent, the coordinator
+        # would hold the tensor forever, and every rank's matching
+        # collective would deadlock — so unsent metas carry over
+        # (resubmitting a name the coordinator already has is idempotent)
+        metas = list(self._unannounced)
+        for e in batch:
+            if e.kind == "list":  # local-only op: no cross-process leg
+                self._finish_entries([e], lambda es: self._exec_single(
+                    es[0], es[0].op, "list"))
+                continue
+            t = e.tensor
+            dtype = getattr(t, "dtype", None) or np.result_type(t)
+            metas.append(neg.EntryMeta(e.name, e.op, dtype, np.shape(t),
+                                       e.root_rank, e.average))
+            self._negotiated_pending[e.name] = e
+        t0 = time.perf_counter()
+        try:
+            resp = self._negotiator.cycle(metas, self._applied_seq)
+        except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
+            self._unannounced = metas
+            self._cycle_failures += 1
+            if self._cycle_failures >= 3:
+                # the coordinator is gone (rank 0 exited/crashed): fail
+                # pending work with a clear error instead of hanging
+                self._fail_pending_negotiated(ShutdownError(
+                    f"negotiation control plane unreachable: {exc}"))
+                self._unannounced = []
+            return
+        self._unannounced = []
+        self._cycle_failures = 0
+        executed_bytes = self._apply_cycle_response(resp)
+        if self.autotuner is not None and executed_bytes > 0:
+            if self.autotuner.record_cycle(executed_bytes,
+                                           time.perf_counter() - t0):
+                # rank 0 applies directly: coordinator fusion reads this
+                # config live, and workers mirror it off the responses
+                self._config.fusion_threshold = int(
+                    self.autotuner.threshold)
+                self._config.cycle_time_ms = float(
+                    self.autotuner.cycle_time_ms)
+
+    def _finish_entries(self, entries, exec_fn):
+        """Run exec_fn over entries, then complete them (status, table
+        removal, event) — the bookkeeping half of _execute."""
+        try:
+            exec_fn(entries)
+            for e in entries:
+                e.status = True
+        except Exception as exc:  # noqa: BLE001 — status carries it
+            for e in entries:
+                e.status = exc
+        finally:
+            with self._queue_lock:
+                for e in entries:
+                    self._tensor_table.pop(e.name, None)
+                    e.event.set()
+
+    def _apply_cycle_response(self, resp):
+        """Apply coordinator responses strictly in seq order; returns the
+        payload bytes executed (the autotuner's numerator)."""
+        executed_bytes = 0
+        for off, r in enumerate(resp.responses):
+            seq = resp.base_seq + off
+            if seq <= self._applied_seq:
+                continue
+            entries = [self._negotiated_pending.pop(n)
+                       for n in r.names if n in self._negotiated_pending]
+            if len(entries) != len(r.names):
+                # control-plane state diverged (e.g. pending was failed
+                # after transient unreachability but the coordinator was
+                # actually alive and later ordered the tensors). Raising
+                # here would wedge the loop — the background thread logs
+                # and retries the same seqs forever while the popped
+                # entries' synchronize() hangs. Fail cleanly instead.
+                missing = [n for n in r.names
+                           if all(e.name != n for e in entries)]
+                exc = ShutdownError(
+                    f"control-plane state diverged: coordinator ordered "
+                    f"{r.names} but {missing} are not pending here")
+                for e in entries:
+                    e.status = exc
+                with self._queue_lock:
+                    for e in entries:
+                        self._tensor_table.pop(e.name, None)
+                        e.event.set()
+                self._fail_pending_negotiated(exc)
+                self._applied_seq = seq
+                continue
+            if self.timeline:
+                for e in entries:
+                    self.timeline.negotiate_end(e.name)
+            if r.kind == r.ERROR:
+                exc = MismatchError(r.error)
+                for e in entries:
+                    e.status = exc
+                with self._queue_lock:
+                    for e in entries:
+                        self._tensor_table.pop(e.name, None)
+                        e.event.set()
+            elif r.op == ALLREDUCE and len(entries) > 1:
+                executed_bytes += sum(_entry_nbytes(e) for e in entries)
+                self._finish_entries(
+                    entries,
+                    lambda es: self._exec_fused_replicated_allreduce(
+                        es, es[0].average))
+            else:
+                executed_bytes += _entry_nbytes(entries[0])
+                self._finish_entries(
+                    entries, lambda es: self._exec_single(es[0], r.op,
+                                                          "replicated"))
+            self._applied_seq = seq
+        if resp.params and jax.process_index() != 0:
+            # mirror rank 0's (possibly autotuned) knobs; fusion decisions
+            # happen at the coordinator, so adoption timing is free
+            self._config.fusion_threshold = int(resp.params[0])
+            self._config.cycle_time_ms = float(resp.params[1])
+        if resp.shutdown:
+            self._fail_pending_negotiated(ShutdownError())
+        return executed_bytes
+
+    def _fail_pending_negotiated(self, exc):
+        with self._queue_lock:
+            pending = list(self._negotiated_pending.values()) + \
+                list(self._queue)
+            self._negotiated_pending.clear()
+            self._queue.clear()
+            for e in pending:
+                self._tensor_table.pop(e.name, None)
+        for e in pending:
+            e.status = exc
+            e.event.set()
+
+    def _exec_fused_replicated_allreduce(self, entries, average):
+        """Coordinator-fused multi-process allreduce: one flattened
+        buffer, ONE cross-process collective for the whole bucket
+        (MPIAllreduce's fusion-buffer memcpy-in/allreduce/memcpy-out,
+        mpi_operations.cc:25-66, on the process axis)."""
+        from jax.experimental import multihost_utils
+        tl = self.timeline
+        names = [e.name for e in entries]
+        if tl:
+            for n in names:
+                tl.start_activity(n, timeline_mod.MEMCPY_IN_FUSION_BUFFER)
+        flats = [np.asarray(e.tensor).reshape(-1) for e in entries]
+        fused = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+                tl.start_activity(n, timeline_mod.ALLREDUCE)
+        gathered = multihost_utils.process_allgather(fused)
+        summed = jnp.sum(jnp.asarray(gathered), axis=0)
+        if average:
+            summed = summed / jax.process_count()
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+                tl.start_activity(n, timeline_mod.MEMCPY_OUT_FUSION_BUFFER)
+        offset = 0
+        for e, flat in zip(entries, flats):
+            n = flat.shape[0]
+            e.result = jnp.reshape(summed[offset:offset + n],
+                                   np.shape(e.tensor))
+            offset += n
+        if tl:
+            for n in names:
+                tl.end_activity(n)
 
     # -- execution engines --
 
@@ -526,7 +745,9 @@ class EagerCoordinator:
             # hits, which diverge with batch-timing skew. Repeats skip it
             # — response-cache-bypass economics (RunBypass,
             # operations.cc:1168-1215) with a coordinated condition.
-            if entry_kind == "replicated":
+            # Under negotiation the coordinator already validated metadata
+            # centrally (EntryMeta.agrees_with) before ordering execution.
+            if entry_kind == "replicated" and self._negotiator is None:
                 vkey = self._verify_key(entry, op)
                 if vkey not in self._verified_sigs:
                     self._verify_cross_process(entry, op)
@@ -830,12 +1051,24 @@ class EagerCoordinator:
             pending = list(self._tensor_table.values())
             self._tensor_table.clear()
             self._queue.clear()
+            self._negotiated_pending.clear()
         exc = ShutdownError()
         for e in pending:
             e.status = exc
             e.event.set()
         if self._thread.is_alive():
             self._thread.join(timeout=2)
+        if self._negotiator is not None:
+            # announce shutdown so peers' pending collectives fail with
+            # SHUT_DOWN_ERROR instead of hanging (RequestList.shutdown →
+            # ResponseList.shutdown, operations.cc:1442-1445,1478)
+            try:
+                self._negotiator.cycle([], self._applied_seq,
+                                       shutdown=True)
+            except Exception:  # noqa: BLE001 — peer may already be gone
+                pass
+            self._negotiator.close()
+            self._negotiator = None
         if self.timeline:
             self.timeline.close()
             self.timeline = None

@@ -1,0 +1,371 @@
+"""Rank-0 coordinator negotiation for the multi-process eager API.
+
+The TPU-native reimplementation of the reference's control plane
+(operations.cc:1217-1245: workers gather readiness Requests to rank 0,
+the coordinator decides which tensors every rank has submitted, fuses
+small ones, and broadcasts an ordered Response plan that every rank then
+executes identically). The reference runs this over MPI; here the control
+plane is the launch layer's HMAC-authenticated TCP protocol
+(run/network.py) so it never touches the accelerators, and the data plane
+stays XLA collectives — the same split as MPI-control/NCCL-data.
+
+Why negotiation at all: without it, the multi-process eager API requires
+every process to submit collectives in exactly the same order (the strict
+SPMD contract, the fallback mode in ops/eager.py). With it, processes may
+submit in any order or tempo — the coordinator holds a tensor back until
+every rank is ready (IncrementTensorCount, operations.cc:164), checks
+shape/dtype/op agreement centrally (ConstructResponse,
+operations.cc:198-400), fuses ready same-dtype allreduces under the
+fusion threshold (FuseResponses, operations.cc:450-573), and assigns the
+one global execution order every process follows.
+
+Protocol: each worker's background cycle sends
+``CycleRequest(rank, new entry metas, last applied seq, shutdown)``; the
+coordinator replies ``CycleResponse(responses after seq, params,
+shutdown)``. Responses are applied strictly in seq order, so the
+data-plane collectives match across processes by construction. Tuned
+autotuner parameters ride every response (the reference broadcasts them
+with a custom MPI struct, parameter_manager.cc:66-81).
+"""
+
+import os
+import socketserver
+import threading
+import time
+
+import numpy as np
+
+from ..common import hvd_logging as log
+from ..run import network, secret
+
+# ops (mirrors eager.py's constants; import cycle keeps them local)
+ALLREDUCE = "allreduce"
+ALLGATHER = "allgather"
+BROADCAST = "broadcast"
+REDUCESCATTER = "reducescatter"
+ALLTOALL = "alltoall"
+
+SERVICE_NAME = "hvd.negotiation"
+CONTROL_PORT_SPAN = 16  # candidate ports above the rendezvous port
+
+
+class EntryMeta:
+    """One tensor's readiness announcement (reference Request,
+    message.h:45)."""
+
+    __slots__ = ("name", "op", "dtype", "shape", "root_rank", "average")
+
+    def __init__(self, name, op, dtype, shape, root_rank, average):
+        self.name = name
+        self.op = op
+        self.dtype = str(dtype)
+        self.shape = tuple(int(d) for d in shape)
+        self.root_rank = int(root_rank)
+        self.average = bool(average)
+
+    def agrees_with(self, other):
+        """Cross-rank compatibility (ConstructResponse checks,
+        operations.cc:209-371): everything must match exactly, except an
+        allgather's first dim (MPI_Allgatherv semantics)."""
+        if (self.op, self.dtype, self.root_rank, self.average) != \
+                (other.op, other.dtype, other.root_rank, other.average):
+            return False
+        if len(self.shape) != len(other.shape):
+            return False
+        a, b = self.shape, other.shape
+        if self.op == ALLGATHER and len(a) >= 1:
+            a, b = a[1:], b[1:]
+        return a == b
+
+    def nbytes(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        itemsize = np.dtype(self.dtype).itemsize if self.dtype else 4
+        return n * itemsize
+
+
+class CycleRequest:
+    def __init__(self, rank, entries, ack, shutdown=False):
+        self.rank = rank
+        self.entries = entries  # list[EntryMeta]
+        self.ack = ack          # last response seq this worker applied
+        self.shutdown = shutdown
+
+
+class NegotiatedResponse:
+    """One unit of agreed work (reference Response, message.h:130)."""
+
+    __slots__ = ("kind", "op", "names", "error")
+    EXECUTE = "execute"
+    ERROR = "error"
+
+    def __init__(self, kind, op, names, error=None):
+        self.kind = kind
+        self.op = op
+        self.names = names  # >1 names = fused allreduce
+        self.error = error
+
+
+class CycleResponse:
+    def __init__(self, base_seq, responses, params, shutdown):
+        self.base_seq = base_seq      # seq of responses[0]
+        self.responses = responses    # list[NegotiatedResponse]
+        self.params = params          # (fusion_threshold, cycle_time_ms)
+        self.shutdown = shutdown
+
+
+class _TableRow:
+    __slots__ = ("metas", "first_ts", "warned")
+
+    def __init__(self):
+        self.metas = {}   # rank -> EntryMeta
+        self.first_ts = time.monotonic()
+        self.warned = False
+
+
+class CoordinatorService(network.BasicService):
+    """Rank 0's negotiation server (the coordinator role of
+    BackgroundThreadLoop, operations.cc:1246-1551, minus the data plane).
+
+    All state mutations happen under one lock inside request handling;
+    the handler never blocks on collectives, so the TCP plane stays
+    responsive regardless of data-plane progress.
+    """
+
+    def __init__(self, nproc, key, ports, config):
+        self._nproc = nproc
+        self._config = config  # rank 0's HorovodConfig (live object)
+        self._lock = threading.Lock()
+        self._table = {}          # name -> _TableRow
+        self._order = []          # names in first-submission order
+        # responses[i] has seq = _base_seq + i; prefixes every rank has
+        # acknowledged are pruned so the log stays bounded over long runs
+        self._responses = []
+        self._base_seq = 0
+        self._acks = {}           # rank -> last acknowledged seq
+        self._shutdown = False
+        self._ports = ports
+        super().__init__(SERVICE_NAME, key)
+
+    # bind to one of the agreed candidate ports instead of an ephemeral
+    # one, so workers can find the coordinator without a side channel
+    def _bind_ephemeral(self):
+        last_err = None
+        for port in self._ports:
+            try:
+                srv = socketserver.ThreadingTCPServer(
+                    ("0.0.0.0", port), self._make_handler())
+                srv.daemon_threads = True
+                return srv
+            except OSError as e:
+                last_err = e
+        raise RuntimeError(
+            f"negotiation coordinator: no free port in {self._ports}: "
+            f"{last_err}")
+
+    def _handle(self, req, client_address):
+        if isinstance(req, network.PingRequest):
+            return network.PingResponse(SERVICE_NAME, client_address[0])
+        if isinstance(req, CycleRequest):
+            with self._lock:
+                if req.shutdown:
+                    self._shutdown = True
+                self._acks[req.rank] = max(
+                    self._acks.get(req.rank, -1), req.ack)
+                self._submit(req.rank, req.entries)
+                self._negotiate()
+                self._stall_scan()
+                self._prune_acknowledged()
+                start = max(0, req.ack + 1 - self._base_seq)
+                return CycleResponse(
+                    self._base_seq + start, list(self._responses[start:]),
+                    (self._config.fusion_threshold,
+                     self._config.cycle_time_ms),
+                    self._shutdown)
+        raise NotImplementedError(req)
+
+    def _prune_acknowledged(self):
+        """Drop response prefixes every rank has applied (each rank's ack
+        rides its CycleRequest), bounding coordinator memory over long
+        runs."""
+        if len(self._acks) < self._nproc or not self._responses:
+            return
+        min_ack = min(self._acks.values())
+        drop = min_ack + 1 - self._base_seq
+        if drop > 0:
+            del self._responses[:drop]
+            self._base_seq += drop
+
+    def _submit(self, rank, entries):
+        for meta in entries:
+            row = self._table.get(meta.name)
+            if row is None:
+                row = self._table[meta.name] = _TableRow()
+                self._order.append(meta.name)
+            row.metas[rank] = meta
+
+    def _negotiate(self):
+        """Promote fully-submitted names to responses: meta agreement
+        check, then fusion of ready same-dtype allreduces in ready order
+        (ConstructResponse + FuseResponses)."""
+        ready = []
+        for name in self._order:
+            row = self._table.get(name)
+            if row is not None and len(row.metas) == self._nproc:
+                ready.append(name)
+        if not ready:
+            return
+        checked = []
+        for name in ready:
+            row = self._table.pop(name)
+            self._order.remove(name)
+            base = row.metas[0]
+            bad = [(r, m) for r, m in sorted(row.metas.items())
+                   if not base.agrees_with(m)]
+            if bad:
+                r, m = bad[0]
+                self._responses.append(NegotiatedResponse(
+                    NegotiatedResponse.ERROR, base.op, [name],
+                    error=(
+                        f"Mismatched {base.op} '{name}' across processes: "
+                        f"process 0 submitted op={base.op} "
+                        f"dtype={base.dtype} root={base.root_rank} "
+                        f"shape={base.shape}, process {r} submitted "
+                        f"op={m.op} dtype={m.dtype} root={m.root_rank} "
+                        f"shape={m.shape} (ConstructResponse checks, "
+                        f"operations.cc:209-371).")))
+            else:
+                checked.append((name, base))
+        # fusion: greedy look-ahead over the ready list, grouping
+        # allreduces by (dtype, average) under the fusion threshold
+        threshold = self._config.fusion_threshold
+        used = set()
+        for i, (name, meta) in enumerate(checked):
+            if name in used:
+                continue
+            if meta.op != ALLREDUCE:
+                self._responses.append(NegotiatedResponse(
+                    NegotiatedResponse.EXECUTE, meta.op, [name]))
+                continue
+            group, group_bytes = [name], meta.nbytes()
+            if threshold > 0:
+                for other, ometa in checked[i + 1:]:
+                    if (other in used or ometa.op != ALLREDUCE
+                            or ometa.dtype != meta.dtype
+                            or ometa.average != meta.average):
+                        continue
+                    if group_bytes + ometa.nbytes() > threshold:
+                        continue
+                    group.append(other)
+                    group_bytes += ometa.nbytes()
+            used.update(group)
+            self._responses.append(NegotiatedResponse(
+                NegotiatedResponse.EXECUTE, ALLREDUCE, group))
+
+    def _stall_scan(self):
+        warn = self._config.stall_warning_time_seconds
+        if self._config.stall_check_disable or warn <= 0:
+            return
+        now = time.monotonic()
+        for name in self._order:
+            row = self._table[name]
+            if not row.warned and now - row.first_ts > warn:
+                row.warned = True
+                missing = sorted(set(range(self._nproc)) -
+                                 set(row.metas.keys()))
+                log.warning(
+                    "One or more tensors were submitted to be reduced, "
+                    "gathered or broadcasted by subset of ranks and are "
+                    "waiting for remainder of ranks for more than %ss: "
+                    "%s (missing ranks: %s)", warn, name, missing)
+
+
+def control_addresses():
+    """Candidate (host, port) list for the coordinator service.
+
+    ``HVD_CONTROL_ADDR`` (host:port) pins it exactly; otherwise derived
+    from the jax.distributed rendezvous (``HVD_COORDINATOR_ADDR``, the
+    env our launchers export — run/cli.py, run/launch.py — or the live
+    jax distributed client's address): the coordinator binds the first
+    free port in [rendezvous+1000, rendezvous+1000+span) and workers
+    probe them all (run/network.py BasicClient). Returns None when no
+    rendezvous is known — callers fall back to non-negotiated mode."""
+    pinned = os.environ.get("HVD_CONTROL_ADDR")
+    if pinned:
+        host, _, port = pinned.rpartition(":")
+        return [(host, int(port))]
+    addr = os.environ.get("HVD_COORDINATOR_ADDR")
+    if not addr:
+        try:  # auto-configured rendezvous (TPU pods)
+            from jax._src import distributed
+            addr = distributed.global_state.coordinator_address
+        except Exception:
+            addr = None
+    if not addr:
+        return None
+    host, _, port = addr.rpartition(":")
+    base = int(port) + 1000
+    return [(host, p) for p in range(base, base + CONTROL_PORT_SPAN)]
+
+
+def control_key():
+    """The control-plane HMAC key: the launcher's per-job secret
+    (HVD_SECRET_KEY, reference run/common/util/secret.py). Returns None
+    when unset — the caller must then fall back to non-negotiated mode.
+    NO derived fallback: the wire protocol deserializes pickles, so a key
+    computable from public information (addresses, constants) would make
+    the 0.0.0.0-bound coordinator remotely scriptable; an unauthenticated
+    channel is strictly worse than no channel."""
+    k = os.environ.get(secret.HVD_SECRET_KEY)
+    if not k:
+        return None
+    import base64
+    return base64.b64decode(k)
+
+
+class NegotiationWorker:
+    """Every process's client side (rank 0 additionally hosts the
+    service). ``cycle()`` is called from the eager background loop; it
+    never runs data-plane collectives itself."""
+
+    def __init__(self, rank, nproc, config, addresses, key,
+                 start_timeout_s=120.0):
+        self._rank = rank
+        self._nproc = nproc
+        self.service = None
+        if rank == 0:
+            ports = sorted({p for _, p in addresses})
+            self.service = CoordinatorService(nproc, key, ports, config)
+        # workers may start before rank 0's server is up: retry the probe
+        deadline = time.monotonic() + start_timeout_s
+        addr_map = {"control": list(addresses)}
+        last = None
+        while True:
+            try:
+                self._client = network.BasicClient(
+                    SERVICE_NAME, addr_map, key, probe_timeout=2.0,
+                    attempts=1)
+                break
+            except network.NoValidAddressesFound as e:
+                last = e
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"negotiation: coordinator unreachable at "
+                        f"{addresses} after {start_timeout_s}s") from last
+                time.sleep(0.2)
+
+    def cycle(self, entries, ack, shutdown=False):
+        return self._client.request(
+            CycleRequest(self._rank, entries, ack, shutdown))
+
+    def close(self, linger_s=2.0):
+        """Stop the coordinator service — after a grace window, so peers
+        mid-cycle still receive their shutdown=True responses instead of
+        connection errors (the reference's shutdown Response reaches every
+        rank before MPI_Finalize, operations.cc:1101-1122)."""
+        if self.service is not None:
+            service, self.service = self.service, None
+            timer = threading.Timer(linger_s, service.shutdown)
+            timer.daemon = True
+            timer.start()

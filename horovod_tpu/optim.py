@@ -201,14 +201,33 @@ def broadcast_object(obj, root_rank=0):
         return obj
     import pickle
     import numpy as np
-    from jax.experimental import multihost_utils
+    # Two eager broadcasts through the coordination core (NOT direct
+    # multihost calls: under rank-0 negotiation every cross-process
+    # collective must originate from the core's background cycle, or its
+    # ordering would race the negotiated stream). Non-root ranks learn
+    # the payload length from the first broadcast.
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    length = multihost_utils.broadcast_one_to_all(
-        np.asarray(len(payload), dtype=np.int64),
-        is_source=jax.process_index() == root_rank)
-    buf = np.zeros(int(length), dtype=np.uint8)
-    if jax.process_index() == root_rank:
+    is_root = jax.process_index() == root_rank
+    # int32 hi/lo pair: int64 would be silently truncated by jax without
+    # x64, and a single int32 caps the payload at 2 GiB
+    hi, lo = divmod(len(payload) if is_root else 0, 1 << 31)
+    length = np.asarray([hi, lo], np.int32)
+    length = np.asarray(mpi_ops.broadcast(length, root_rank=root_rank,
+                                          name=_bcast_object_name("len")))
+    buf = np.zeros((int(length[0]) << 31) + int(length[1]), dtype=np.uint8)
+    if is_root:
         buf[:] = payload
-    buf = multihost_utils.broadcast_one_to_all(
-        buf, is_source=jax.process_index() == root_rank)
+    buf = np.asarray(mpi_ops.broadcast(buf, root_rank=root_rank,
+                                       name=_bcast_object_name("payload")))
     return pickle.loads(buf.tobytes())
+
+
+_bcast_object_counter = [0]
+
+
+def _bcast_object_name(part):
+    # matched across processes by call order (same program), like every
+    # auto-generated collective name
+    if part == "len":
+        _bcast_object_counter[0] += 1
+    return f"hvd.broadcast_object.{_bcast_object_counter[0]}.{part}"

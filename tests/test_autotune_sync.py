@@ -1,8 +1,10 @@
-"""Multi-process autotune: process 0 tunes, every process adopts the
-tuned fusion-threshold/cycle-time at the same agreed point in the
-replicated-collective order (the reference coordinator's parameter
-broadcast, parameter_manager.cc:66-81; scheduling via
-HOROVOD_AUTOTUNE_SYNC_COLLECTIVES)."""
+"""Multi-process autotune: process 0 tunes and the other processes adopt
+the tuned fusion-threshold/cycle-time (the reference coordinator's
+parameter broadcast, parameter_manager.cc:66-81). Under rank-0
+negotiation the values ride every CycleResponse; in the non-negotiated
+fallback they sync via the count-scheduled allgather
+(_sync_tuned_params, HOROVOD_AUTOTUNE_SYNC_COLLECTIVES) the TestSyncUnit
+cases exercise."""
 
 import numpy as np
 
@@ -38,10 +40,16 @@ class TestAutotuneSync:
             return out
 
         results = run(fn, num_proc=2, env=_ENV)
-        assert results[0] == results[1], results
-        # the tuner moved the knobs off the defaults by the time the 8th
-        # replicated collective synced them (suggestions land each cycle)
-        assert results[0] != (64 * 1024 * 1024, 5.0), results
+        # every process adopted tuned (non-default) values: rank 0 tunes,
+        # the others mirror the knobs off the coordinator's responses.
+        # Exact equality across processes is not asserted — a worker's
+        # mirror is as fresh as its last applied response, and rank 0 may
+        # have staged a newer suggestion since (the reference has the
+        # same propagation lag between coordinator tune steps and worker
+        # parameter updates, parameter_manager.cc:66-81).
+        default = (64 * 1024 * 1024, 5.0)
+        for res in results:
+            assert res != default, results
 
     def test_results_stay_correct_while_tuning(self):
         def fn():

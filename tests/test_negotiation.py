@@ -1,0 +1,264 @@
+"""Rank-0 coordinator negotiation (ops/negotiation.py — the reference's
+Request/Response control plane, operations.cc:1217-1245): any-order
+submission across processes, coordinator-side fusion and meta checking,
+subset-stall reporting, shutdown propagation."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.run.launch import run
+
+_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+class TestCoordinatorUnit:
+    """CoordinatorService negotiation logic, no processes involved."""
+
+    def _service(self, nproc=2, threshold=64 << 20):
+        from horovod_tpu.common.config import HorovodConfig
+        from horovod_tpu.ops import negotiation as neg
+        cfg = HorovodConfig(fusion_threshold=threshold,
+                            stall_warning_time_seconds=0)
+        svc = neg.CoordinatorService(nproc, b"k" * 32,
+                                     ports=[0], config=cfg)
+        return svc, neg
+
+    def _meta(self, neg, name, op="allreduce", dtype="float32",
+              shape=(4,), root=0, average=False):
+        return neg.EntryMeta(name, op, dtype, shape, root, average)
+
+    def test_holds_until_all_ranks_submit(self):
+        svc, neg = self._service()
+        try:
+            svc._submit(0, [self._meta(neg, "a")])
+            svc._negotiate()
+            assert svc._responses == []
+            svc._submit(1, [self._meta(neg, "a")])
+            svc._negotiate()
+            assert len(svc._responses) == 1
+            assert svc._responses[0].names == ["a"]
+        finally:
+            svc.shutdown()
+
+    def test_fuses_ready_same_dtype_allreduces(self):
+        svc, neg = self._service()
+        try:
+            metas = [self._meta(neg, f"g{i}") for i in range(4)] + \
+                [self._meta(neg, "d", dtype="float64")] + \
+                [self._meta(neg, "b", op="broadcast")]
+            svc._submit(0, metas)
+            svc._submit(1, metas)
+            svc._negotiate()
+            kinds = [(r.op, tuple(r.names)) for r in svc._responses]
+            assert ("allreduce", ("g0", "g1", "g2", "g3")) in kinds
+            assert ("allreduce", ("d",)) in kinds
+            assert ("broadcast", ("b",)) in kinds
+        finally:
+            svc.shutdown()
+
+    def test_fusion_respects_threshold(self):
+        # 4-float tensors = 16 bytes each; threshold 32 → pairs
+        svc, neg = self._service(threshold=32)
+        try:
+            metas = [self._meta(neg, f"g{i}") for i in range(4)]
+            svc._submit(0, metas)
+            svc._submit(1, metas)
+            svc._negotiate()
+            groups = [r.names for r in svc._responses]
+            assert groups == [["g0", "g1"], ["g2", "g3"]]
+        finally:
+            svc.shutdown()
+
+    def test_zero_threshold_disables_fusion(self):
+        svc, neg = self._service(threshold=0)
+        try:
+            metas = [self._meta(neg, f"g{i}") for i in range(3)]
+            svc._submit(0, metas)
+            svc._submit(1, metas)
+            svc._negotiate()
+            assert [r.names for r in svc._responses] == \
+                [["g0"], ["g1"], ["g2"]]
+        finally:
+            svc.shutdown()
+
+    def test_meta_mismatch_becomes_error_response(self):
+        svc, neg = self._service()
+        try:
+            svc._submit(0, [self._meta(neg, "x", shape=(2, 3))])
+            svc._submit(1, [self._meta(neg, "x", shape=(2, 4))])
+            svc._negotiate()
+            (r,) = svc._responses
+            assert r.kind == r.ERROR
+            assert "x" in r.error and "ConstructResponse" in r.error
+        finally:
+            svc.shutdown()
+
+    def test_response_log_pruned_after_all_ranks_ack(self):
+        from horovod_tpu.ops.negotiation import CycleRequest
+        svc, neg = self._service()
+        try:
+            dtypes = ["float32", "float64", "int32", "int64"]  # no fusion
+            for i in range(4):
+                svc._submit(0, [self._meta(neg, f"t{i}", dtype=dtypes[i])])
+                svc._submit(1, [self._meta(neg, f"t{i}", dtype=dtypes[i])])
+            svc._negotiate()
+            assert len(svc._responses) == 4
+            # both ranks acknowledge seq 2 → seqs 0..2 pruned
+            svc._handle(CycleRequest(0, [], ack=2), ("127.0.0.1", 0))
+            svc._handle(CycleRequest(1, [], ack=2), ("127.0.0.1", 0))
+            assert svc._base_seq == 3 and len(svc._responses) == 1
+            # a straggler request for older seqs still gets the tail
+            resp = svc._handle(CycleRequest(0, [], ack=2),
+                               ("127.0.0.1", 0))
+            assert resp.base_seq == 3 and len(resp.responses) == 1
+        finally:
+            svc.shutdown()
+
+    def test_allgather_first_dim_may_differ(self):
+        svc, neg = self._service()
+        try:
+            svc._submit(0, [self._meta(neg, "g", op="allgather",
+                                       shape=(2, 3))])
+            svc._submit(1, [self._meta(neg, "g", op="allgather",
+                                       shape=(5, 3))])
+            svc._negotiate()
+            (r,) = svc._responses
+            assert r.kind == r.EXECUTE
+        finally:
+            svc.shutdown()
+
+
+class TestAnyOrderSubmission:
+    def test_ranks_submit_in_opposite_order(self):
+        """The capability negotiation exists for (reference
+        operations.cc:852-855): eager frameworks cannot guarantee
+        cross-rank submission order. Without the coordinator this
+        deadlocks or mismatches; with it, both complete."""
+        def fn():
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            names = ["A", "B"] if r == 0 else ["B", "A"]
+            handles = {n: hvd.allreduce_async(
+                np.full((3,), 1.0 + (n == "B"), np.float32),
+                average=False, name=n) for n in names}
+            out = {n: float(np.asarray(hvd.synchronize(h))[0])
+                   for n, h in handles.items()}
+            hvd.shutdown()
+            return out
+
+        results = run(fn, num_proc=2, env=_ENV)
+        for res in results:
+            assert res == {"A": 2.0, "B": 4.0}, results
+
+    def test_burst_is_fused_by_coordinator(self):
+        def fn():
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.common import state
+            hvd.init()
+            handles = [hvd.allreduce_async(
+                np.full((8,), float(i), np.float32), average=False,
+                name=f"burst{i}") for i in range(6)]
+            outs = [float(np.asarray(hvd.synchronize(h))[0])
+                    for h in handles]
+            coord = state.global_state().coordinator
+            # 6 tensors completed in fewer responses than tensors →
+            # the coordinator fused them
+            n_responses = coord._applied_seq + 1
+            hvd.shutdown()
+            return outs, n_responses
+
+        results = run(fn, num_proc=2, env=_ENV)
+        for outs, n_responses in results:
+            assert outs == [2.0 * i for i in range(6)]
+            assert n_responses < 6, n_responses
+
+    def test_broadcast_object_rides_the_core(self):
+        def fn():
+            import os
+            import horovod_tpu.torch as thvd
+            thvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            obj = {"epoch": 7, "blob": list(range(50))} if r == 0 else None
+            out = thvd.broadcast_object(obj, root_rank=0)
+            thvd.shutdown()
+            return out
+
+        results = run(fn, num_proc=2, env=_ENV)
+        want = {"epoch": 7, "blob": list(range(50))}
+        assert results == [want, want]
+
+
+class TestNegotiatedFailure:
+    def test_subset_submission_stalls_not_hangs(self):
+        """A tensor only rank 0 submits must fail its synchronize with
+        StalledError at the shutdown deadline (reference stall shutdown,
+        operations.cc:688-786) — and the coordinator logs the missing
+        ranks meanwhile."""
+        def fn():
+            import logging
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.common import hvd_logging
+            records = []
+
+            class Capture(logging.Handler):
+                def emit(self, record):
+                    records.append(record.getMessage())
+
+            hvd_logging.get_logger().addHandler(Capture())
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            # both ranks run one common collective first
+            hvd.allreduce(np.ones((2,), np.float32), name="common")
+            result = "none"
+            if r == 0:
+                try:
+                    hvd.allreduce(np.ones((2,), np.float32), name="only0")
+                except hvd.StalledError:
+                    result = "stalled"
+            else:
+                import time
+                time.sleep(2.5)
+            warned = any("only0" in m and "missing ranks" in m
+                         for m in records)
+            hvd.shutdown()
+            return result, (warned if r == 0 else None)
+
+        env = dict(_ENV)
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "0.5"
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "1.5"
+        results = run(fn, num_proc=2, env=env)
+        assert results[0][0] == "stalled", results
+        assert results[0][1] is True, results
+
+    def test_peer_shutdown_fails_pending(self):
+        """Rank 1 shuts down while rank 0 waits on a collective rank 1
+        never submitted: rank 0 gets ShutdownError, not a hang
+        (RequestList.shutdown → ResponseList.shutdown,
+        operations.cc:1442-1478)."""
+        def fn():
+            import os
+            import time
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            if r == 1:
+                time.sleep(0.5)
+                hvd.shutdown()
+                return "exited"
+            try:
+                hvd.allreduce(np.ones((2,), np.float32), name="waiting")
+                return "completed"
+            except hvd.ShutdownError:
+                return "shutdown"
+            finally:
+                hvd.shutdown()
+
+        results = run(fn, num_proc=2, env=_ENV)
+        assert results[0] == "shutdown" and results[1] == "exited", results
