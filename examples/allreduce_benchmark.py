@@ -68,12 +68,12 @@ def measure(n_tensors, elems, iters):
 def main():
     args = parse_args()
     hvd.init()
+    from horovod_tpu.common import state
     sizes_kb = [int(s) for s in args.sizes_kb.split(",")]
     results = {}
     for kb in sizes_kb:
         elems = max(1, kb * 1024 // 4 // hvd.size())
         fused = measure(args.num_tensors, elems, args.iters)
-        from horovod_tpu.common import state
         cfg = state.global_state().config
         saved = cfg.fusion_threshold
         cfg.fusion_threshold = 0  # one collective per tensor

@@ -201,14 +201,17 @@ class EagerCoordinator:
         self._negotiated_pending = {}  # name -> entry awaiting a response
         self._applied_seq = -1
         self._cycle_failures = 0
+        self._cycle_req_id = 0
+        self._negotiation_dead = False
         self._unannounced = []  # metas not yet delivered to the coordinator
         if jax.process_count() > 1:
             from . import negotiation as neg
             addrs = neg.control_addresses()
             key = neg.control_key()
             if addrs is None or key is None:
+                from ..run.secret import HVD_SECRET_KEY as _SECRET_ENV
                 missing = ("HVD_CONTROL_ADDR/HVD_COORDINATOR_ADDR"
-                           if addrs is None else "HVD_SECRET_KEY")
+                           if addrs is None else _SECRET_ENV)
                 log.warning(
                     "no %s; the multi-process eager API runs WITHOUT "
                     "rank-0 negotiation — every process must submit "
@@ -264,6 +267,8 @@ class EagerCoordinator:
                 kind=None):
         if self._shutdown:
             raise ShutdownError()
+        if self._negotiation_dead:
+            raise ShutdownError("negotiation control plane lost")
         if op == BROADCAST and not 0 <= root_rank < self._world:
             raise MismatchError(
                 f"Invalid root_rank {root_rank} for broadcast '{name}': "
@@ -468,39 +473,66 @@ class EagerCoordinator:
         originate here, in response-seq order, so they match across
         processes no matter how entries were submitted."""
         from . import negotiation as neg
-        with self._queue_lock:
-            batch = list(self._queue)
-            self._queue.clear()
-        if self.timeline and batch:
-            self.timeline.mark_cycle_start()
-        # announcements survive transient control-plane failures: a meta
-        # dropped on a TCP hiccup would never be resent, the coordinator
-        # would hold the tensor forever, and every rank's matching
-        # collective would deadlock — so unsent metas carry over
-        # (resubmitting a name the coordinator already has is idempotent)
-        metas = list(self._unannounced)
-        for e in batch:
-            if e.kind == "list":  # local-only op: no cross-process leg
-                self._finish_entries([e], lambda es: self._exec_single(
-                    es[0], es[0].op, "list"))
-                continue
-            t = e.tensor
-            dtype = getattr(t, "dtype", None) or np.result_type(t)
-            metas.append(neg.EntryMeta(e.name, e.op, dtype, np.shape(t),
-                                       e.root_rank, e.average))
-            self._negotiated_pending[e.name] = e
+        if self._negotiation_dead:
+            # the control plane was declared lost: anything newly queued
+            # fails fast instead of waiting on negotiation forever
+            self._fail_pending_negotiated(ShutdownError(
+                "negotiation control plane lost"))
+            return
+        # Announcements survive transient control-plane failures: a retry
+        # resends the SAME request id + metas, and the coordinator dedupes
+        # on the id — a response lost after the server processed it must
+        # not cause a re-submit (the names were already negotiated away;
+        # re-submitting would plant ghost table rows no rank completes).
+        # While a retry is outstanding, new queue entries wait their turn.
+        if self._unannounced:
+            metas = self._unannounced
+        else:
+            with self._queue_lock:
+                batch = list(self._queue)
+                self._queue.clear()
+            if self.timeline and batch:
+                self.timeline.mark_cycle_start()
+            metas = []
+            for e in batch:
+                if e.kind == "list":  # local-only op: no cross-process leg
+                    if self.timeline:
+                        self.timeline.negotiate_end(e.name)
+                    self._finish_entries([e], lambda es: self._exec_single(
+                        es[0], es[0].op, "list"))
+                    continue
+                t = e.tensor
+                dtype = getattr(t, "dtype", None) or np.result_type(t)
+                metas.append(neg.EntryMeta(e.name, e.op, dtype,
+                                           np.shape(t), e.root_rank,
+                                           e.average))
+                self._negotiated_pending[e.name] = e
+            self._cycle_req_id += 1
         t0 = time.perf_counter()
         try:
-            resp = self._negotiator.cycle(metas, self._applied_seq)
+            resp = self._negotiator.cycle(metas, self._applied_seq,
+                                          req_id=self._cycle_req_id)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = metas
             self._cycle_failures += 1
             if self._cycle_failures >= 3:
-                # the coordinator is gone (rank 0 exited/crashed): fail
-                # pending work with a clear error instead of hanging
+                # The coordinator is gone (rank 0 exited/crashed): fail
+                # pending work with a clear error instead of hanging, try
+                # to tell the control plane so peers are released rather
+                # than left blocked in matching collectives, and poison
+                # this coordinator — continuing to negotiate after
+                # dropping state would diverge from the peers anyway.
                 self._fail_pending_negotiated(ShutdownError(
                     f"negotiation control plane unreachable: {exc}"))
                 self._unannounced = []
+                self._negotiation_dead = True
+                try:
+                    self._cycle_req_id += 1
+                    self._negotiator.cycle([], self._applied_seq,
+                                           shutdown=True,
+                                           req_id=self._cycle_req_id)
+                except Exception:  # noqa: BLE001 — plane truly gone
+                    pass
             return
         self._unannounced = []
         self._cycle_failures = 0

@@ -86,11 +86,16 @@ class EntryMeta:
 
 
 class CycleRequest:
-    def __init__(self, rank, entries, ack, shutdown=False):
+    def __init__(self, rank, entries, ack, shutdown=False, req_id=0):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
         self.shutdown = shutdown
+        # idempotency token: a retry after a lost response reuses the id,
+        # and the coordinator skips re-submitting entries it already
+        # recorded (a popped-and-resubmitted name would otherwise create
+        # a ghost table row no other rank ever completes)
+        self.req_id = req_id
 
 
 class NegotiatedResponse:
@@ -144,6 +149,7 @@ class CoordinatorService(network.BasicService):
         self._responses = []
         self._base_seq = 0
         self._acks = {}           # rank -> last acknowledged seq
+        self._seen_req = {}       # rank -> last processed request id
         self._shutdown = False
         self._ports = ports
         super().__init__(SERVICE_NAME, key)
@@ -173,7 +179,9 @@ class CoordinatorService(network.BasicService):
                     self._shutdown = True
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
-                self._submit(req.rank, req.entries)
+                if self._seen_req.get(req.rank) != req.req_id:
+                    self._seen_req[req.rank] = req.req_id
+                    self._submit(req.rank, req.entries)
                 self._negotiate()
                 self._stall_scan()
                 self._prune_acknowledged()
@@ -355,9 +363,10 @@ class NegotiationWorker:
                         f"{addresses} after {start_timeout_s}s") from last
                 time.sleep(0.2)
 
-    def cycle(self, entries, ack, shutdown=False):
+    def cycle(self, entries, ack, shutdown=False, req_id=0):
         return self._client.request(
-            CycleRequest(self._rank, entries, ack, shutdown))
+            CycleRequest(self._rank, entries, ack, shutdown,
+                         req_id=req_id))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

@@ -1,0 +1,114 @@
+"""Spark integration: run a horovod_tpu training fn on Spark executors.
+
+TPU-native equivalent of ``horovod.spark.run`` (reference
+spark/__init__.py:93-222): the reference ships ``fn`` to ``num_proc``
+Spark tasks via cloudpickle, has tasks register with a driver service,
+groups hosts, then launches mpirun with orted tunneled through the Spark
+executors. Here the Spark tasks ARE the workers: a barrier stage gives
+every task a rank (its partition id) and a rendezvous channel
+(``BarrierTaskContext.allGather``, filling the role of the reference's
+driver/task registration round); task 0's address becomes the
+jax.distributed coordinator, every task assembles the same ``HVD_*``
+environment ``hvdrun`` would export (run/cli.py), runs ``fn`` in-process,
+and the stage's collect returns the per-rank results in rank order — no
+mpirun, no ssh.
+
+    import horovod_tpu.spark
+    results = horovod_tpu.spark.run(train_fn, num_proc=4)
+"""
+
+import base64
+import os
+import socket
+
+import cloudpickle
+
+try:
+    import pyspark
+except ImportError as _e:  # pragma: no cover - exercised only w/o pyspark
+    raise ImportError(
+        "horovod_tpu.spark requires the pyspark package (the reference "
+        "gate: horovod/spark/__init__.py imports pyspark at module "
+        "scope)") from _e
+
+from ..run import network, secret
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _host_ip():
+    """A non-loopback IP of this executor: gethostname commonly resolves
+    to 127.0.x.1 via /etc/hosts, which would publish an unreachable
+    rendezvous address — reuse the launcher's NIC discovery instead
+    (run/network.py local_addresses; reference NIC intersection,
+    run/run.py:188-257)."""
+    for addrs in network.local_addresses().values():
+        for ip, _ in addrs:
+            if not ip.startswith("127."):
+                return ip
+    return socket.gethostbyname(socket.gethostname())
+
+
+def worker_env(rank, num_proc, coordinator_addr, key_b64, extra_env=None):
+    """The env a Spark task exports before running fn — identical surface
+    to what hvdrun exports per worker (run/cli.py:133-135 plus the job
+    secret the negotiation control plane requires)."""
+    env = {
+        "HVD_COORDINATOR_ADDR": coordinator_addr,
+        "HVD_NUM_PROC": str(num_proc),
+        "HVD_PROCESS_ID": str(rank),
+        secret.HVD_SECRET_KEY: key_b64,
+    }
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, env=None, verbose=1):
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark tasks; returns
+    the list of per-rank results in rank order (reference
+    spark/__init__.py:93-222).
+
+    Requires an active SparkContext (PySpark session). ``num_proc``
+    defaults to ``spark.default.parallelism``, as in the reference.
+    """
+    kwargs = kwargs or {}
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:
+        raise Exception(
+            "Could not find an active SparkContext, are you running in a "
+            "PySpark session?")
+    if num_proc is None:
+        num_proc = sc.defaultParallelism
+        if verbose >= 1:
+            print(f"Running {num_proc} processes (inferred from "
+                  f"spark.default.parallelism)...")
+    elif verbose >= 1:
+        print(f"Running {num_proc} processes...")
+
+    payload = cloudpickle.dumps((fn, args, kwargs))
+    key_b64 = base64.b64encode(secret.make_secret_key()).decode("ascii")
+    extra_env = dict(env or {})
+
+    def _task(_):
+        from pyspark import BarrierTaskContext
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        # registration round (reference driver/task services + NIC
+        # grouping): every task announces its address; task 0 also picks
+        # the rendezvous port
+        host = _host_ip()
+        port = _free_port() if rank == 0 else 0
+        addresses = ctx.allGather(f"{host}:{port}")
+        os.environ.update(worker_env(rank, num_proc, addresses[0],
+                                     key_b64, extra_env))
+        task_fn, task_args, task_kwargs = cloudpickle.loads(payload)
+        yield task_fn(*task_args, **task_kwargs)
+
+    rdd = sc.parallelize(range(num_proc), num_proc).barrier()
+    # partition order == rank order, so collect() is already rank-sorted
+    return rdd.mapPartitions(_task).collect()

@@ -1,0 +1,147 @@
+"""Spark integration (reference test/test_spark.py patterns:
+test_happy_run on local[2], missing-context errors). pyspark is not in
+the image, so a minimal stand-in implementing the exact surface the
+integration uses (SparkContext._active_spark_context, parallelize →
+barrier → mapPartitions → collect, BarrierTaskContext.get/allGather/
+partitionId) runs the barrier stage inline — with one partition the
+shipped fn executes for real, hvd.init() and all."""
+
+import sys
+import types as _types
+
+import numpy as np
+import pytest
+
+
+def _install_fake_pyspark():
+    if "pyspark" in sys.modules:
+        return sys.modules["pyspark"]
+
+    class BarrierTaskContext:
+        _current = None
+
+        def __init__(self, pid, addresses):
+            self._pid = pid
+            self._addresses = addresses
+
+        @classmethod
+        def get(cls):
+            return cls._current
+
+        def partitionId(self):
+            return self._pid
+
+        def allGather(self, message):
+            self._addresses.append(message)
+            return self._addresses
+
+    class _BarrierRDD:
+        def __init__(self, items, n_parts):
+            self._items = items
+            self._n = n_parts
+
+        def mapPartitions(self, f):
+            self._f = f
+            return self
+
+        def collect(self):
+            results, addresses = [], []
+            for pid in range(self._n):
+                BarrierTaskContext._current = BarrierTaskContext(
+                    pid, addresses)
+                try:
+                    results.extend(self._f(iter([self._items[pid]])))
+                finally:
+                    BarrierTaskContext._current = None
+            return results
+
+    class _RDD(_BarrierRDD):
+        def barrier(self):
+            return self
+
+    class SparkContext:
+        _active_spark_context = None
+
+        def __init__(self, default_parallelism=2):
+            self.defaultParallelism = default_parallelism
+
+        def parallelize(self, data, n_parts):
+            return _RDD(list(data), n_parts)
+
+    mod = _types.ModuleType("pyspark")
+    mod.SparkContext = SparkContext
+    mod.BarrierTaskContext = BarrierTaskContext
+    sys.modules["pyspark"] = mod
+    return mod
+
+
+@pytest.fixture
+def pyspark():
+    return _install_fake_pyspark()
+
+
+@pytest.fixture
+def shvd(pyspark):
+    import os
+    import horovod_tpu.spark as shvd_mod
+    yield shvd_mod
+    pyspark.SparkContext._active_spark_context = None
+    # inline "tasks" export worker env into this test process — scrub it
+    from horovod_tpu.run import secret
+    for k in ("HVD_COORDINATOR_ADDR", "HVD_NUM_PROC", "HVD_PROCESS_ID",
+              secret.HVD_SECRET_KEY):
+        os.environ.pop(k, None)
+
+
+class TestSparkRun:
+    def test_requires_active_context(self, shvd):
+        with pytest.raises(Exception, match="active SparkContext"):
+            shvd.run(lambda: 0, num_proc=1)
+
+    def test_happy_run_single_task(self, pyspark, shvd, monkeypatch):
+        """reference test_spark.py:51-69 test_happy_run: fn runs on the
+        tasks, per-rank results come back in rank order. One partition →
+        the whole path (barrier allGather rendezvous, HVD_* env, fn
+        execution with a real hvd.init) runs inline."""
+        monkeypatch.setattr(pyspark.SparkContext,
+                            "_active_spark_context",
+                            pyspark.SparkContext())
+        # fn runs in THIS process: the env the barrier task exports must
+        # not leak jax.distributed bootstrap into our single-process jax
+        monkeypatch.delenv("HVD_COORDINATOR_ADDR", raising=False)
+
+        def fn(mult):
+            import os
+            from horovod_tpu.run import secret
+            assert os.environ["HVD_NUM_PROC"] == "1"
+            assert secret.HVD_SECRET_KEY in os.environ
+            # single task: init without the multi-process bootstrap
+            os.environ.pop("HVD_COORDINATOR_ADDR", None)
+            import horovod_tpu as hvd
+            import numpy as np
+            hvd.init()
+            out = float(np.asarray(
+                hvd.allreduce(np.full((3,), 2.0), average=False))[0])
+            hvd.shutdown()
+            return out * mult
+
+        assert shvd.run(fn, args=(10,), num_proc=1) == [20.0]
+
+    def test_default_parallelism_inferred(self, pyspark, shvd,
+                                          monkeypatch, capsys):
+        monkeypatch.setattr(pyspark.SparkContext,
+                            "_active_spark_context",
+                            pyspark.SparkContext(default_parallelism=3))
+        ranks = shvd.run(lambda: 0, num_proc=3, verbose=1)
+        assert ranks == [0, 0, 0]
+        assert "Running 3 processes" in capsys.readouterr().out
+
+    def test_worker_env_matches_hvdrun_surface(self, shvd):
+        from horovod_tpu.run import secret
+        env = shvd.worker_env(2, 4, "10.0.0.1:1234", "a2V5",
+                              extra_env={"FOO": "1"})
+        assert env["HVD_COORDINATOR_ADDR"] == "10.0.0.1:1234"
+        assert env["HVD_NUM_PROC"] == "4"
+        assert env["HVD_PROCESS_ID"] == "2"
+        assert env[secret.HVD_SECRET_KEY] == "a2V5"
+        assert env["FOO"] == "1"
