@@ -41,8 +41,9 @@ void hvd_log(int level, const char* msg) {
 }
 
 // ---------------------------------------------------------------------------
-// fusion planner — greedy look-ahead bucketing in submission order, one
-// open bucket per dtype, oversized tensors alone (FuseResponses semantics).
+// fusion planner — look-ahead bucketing in submission order: first-fit
+// across all open same-dtype buckets, non-fitting tensors open new ones
+// without closing the old (FuseResponses semantics).
 // ---------------------------------------------------------------------------
 
 int64_t hvd_plan_buckets(int64_t n, const int64_t* nbytes,
@@ -57,16 +58,25 @@ int64_t hvd_plan_buckets(int64_t n, const int64_t* nbytes,
     int32_t id;
     int64_t bytes;
   };
-  std::unordered_map<int32_t, Open> open;  // dtype -> open bucket
+  // First-fit across ALL open same-dtype buckets: the reference's
+  // look-ahead skips a non-fitting entry but lets LATER entries join the
+  // same response (FuseResponses, operations.cc:478-533).
+  std::unordered_map<int32_t, std::vector<Open>> open;  // dtype -> buckets
   int32_t next_id = 0;
   for (int64_t i = 0; i < n; ++i) {
-    auto it = open.find(dtype_ids[i]);
-    if (it != open.end() && it->second.bytes + nbytes[i] <= threshold) {
-      bucket_out[i] = it->second.id;
-      it->second.bytes += nbytes[i];
-    } else {
+    auto& buckets = open[dtype_ids[i]];
+    bool placed = false;
+    for (auto& b : buckets) {
+      if (b.bytes + nbytes[i] <= threshold) {
+        bucket_out[i] = b.id;
+        b.bytes += nbytes[i];
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {
       bucket_out[i] = next_id;
-      open[dtype_ids[i]] = Open{next_id, nbytes[i]};
+      buckets.push_back(Open{next_id, nbytes[i]});
       ++next_id;
     }
   }

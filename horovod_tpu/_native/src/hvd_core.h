@@ -43,9 +43,11 @@ HVD_EXPORT int hvd_log_get_level();
 HVD_EXPORT void hvd_log(int level, const char* msg);
 
 // ---- fusion planner -------------------------------------------------------
-// Greedy look-ahead bucketing: same-dtype tensors packed in submission
-// order into buckets of <= threshold bytes; oversized tensors go alone.
-// Writes bucket id per tensor into bucket_out; returns the bucket count.
+// Look-ahead bucketing: same-dtype tensors packed in submission order,
+// first-fit across all open buckets of <= threshold bytes — a tensor that
+// does not fit opens a new bucket without closing the old, so later small
+// tensors still join it (FuseResponses semantics); oversized tensors ride
+// alone. Writes bucket id per tensor into bucket_out; returns the count.
 HVD_EXPORT int64_t hvd_plan_buckets(int64_t n, const int64_t* nbytes,
                                     const int32_t* dtype_ids,
                                     int64_t threshold, int32_t* bucket_out);

@@ -83,19 +83,26 @@ def _native_plan(sizes, dtypes, threshold):
 
 
 def _python_plan(sizes, dtypes, threshold):
+    # First-fit across ALL open same-dtype buckets — the reference's
+    # look-ahead: an entry that does not fit the current response is
+    # skipped, and LATER entries may still join that response
+    # (FuseResponses, operations.cc:478-533). Closing a bucket on
+    # overflow would strand later small tensors in extra collectives.
     if threshold <= 0:
         return list(range(len(sizes)))
     assignment = []
-    open_buckets = {}  # dtype -> (bucket id, bytes)
+    open_buckets = {}  # dtype -> [(bucket id, bytes)...] creation order
     next_id = 0
-    for i, (nb, dt) in enumerate(zip(sizes, dtypes)):
-        cur = open_buckets.get(str(dt))
-        if cur is not None and cur[1] + nb <= threshold:
-            assignment.append(cur[0])
-            open_buckets[str(dt)] = (cur[0], cur[1] + nb)
+    for nb, dt in zip(sizes, dtypes):
+        buckets = open_buckets.setdefault(str(dt), [])
+        for j, (bid, used) in enumerate(buckets):
+            if used + nb <= threshold:
+                assignment.append(bid)
+                buckets[j] = (bid, used + nb)
+                break
         else:
             assignment.append(next_id)
-            open_buckets[str(dt)] = (next_id, nb)
+            buckets.append((next_id, nb))
             next_id += 1
     return assignment
 

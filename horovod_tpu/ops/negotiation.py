@@ -77,13 +77,6 @@ class EntryMeta:
             a, b = a[1:], b[1:]
         return a == b
 
-    def nbytes(self):
-        n = 1
-        for d in self.shape:
-            n *= d
-        itemsize = np.dtype(self.dtype).itemsize if self.dtype else 4
-        return n * itemsize
-
 
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0):
@@ -245,31 +238,35 @@ class CoordinatorService(network.BasicService):
                         f"operations.cc:209-371).")))
             else:
                 checked.append((name, base))
-        # fusion: greedy look-ahead over the ready list, grouping
-        # allreduces by (dtype, average) under the fusion threshold
+        # Fusion: the same look-ahead dtype-bucketing planner (native
+        # hvd_plan_buckets when built) that serves the jit path and the
+        # eager stacked path — EntryMeta quacks like a leaf (shape/dtype).
+        # Partitioned by `average` first: sum and mean cannot share a
+        # fused buffer.
+        from . import fusion as fusion_mod
         threshold = self._config.fusion_threshold
-        used = set()
-        for i, (name, meta) in enumerate(checked):
-            if name in used:
+        anchors = {}  # first checked-index of a bucket -> member indices
+        for avg in (False, True):
+            idx = [i for i, (_, m) in enumerate(checked)
+                   if m.op == ALLREDUCE and m.average is avg]
+            if not idx:
                 continue
+            buckets = fusion_mod.plan_buckets(
+                [checked[i][1] for i in idx], threshold)
+            for b in buckets:
+                members = [idx[j] for j in b.indices]
+                anchors[members[0]] = members
+        for i, (name, meta) in enumerate(checked):
             if meta.op != ALLREDUCE:
                 self._responses.append(NegotiatedResponse(
                     NegotiatedResponse.EXECUTE, meta.op, [name]))
                 continue
-            group, group_bytes = [name], meta.nbytes()
-            if threshold > 0:
-                for other, ometa in checked[i + 1:]:
-                    if (other in used or ometa.op != ALLREDUCE
-                            or ometa.dtype != meta.dtype
-                            or ometa.average != meta.average):
-                        continue
-                    if group_bytes + ometa.nbytes() > threshold:
-                        continue
-                    group.append(other)
-                    group_bytes += ometa.nbytes()
-            used.update(group)
+            members = anchors.get(i)
+            if members is None:  # emitted with an earlier anchor
+                continue
             self._responses.append(NegotiatedResponse(
-                NegotiatedResponse.EXECUTE, ALLREDUCE, group))
+                NegotiatedResponse.EXECUTE, ALLREDUCE,
+                [checked[j][0] for j in members]))
 
     def _stall_scan(self):
         warn = self._config.stall_warning_time_seconds

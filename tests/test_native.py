@@ -36,6 +36,19 @@ def test_plan_buckets_matches_python():
         assert native == python, threshold
 
 
+def test_plan_buckets_lookahead_skips_oversized():
+    """FuseResponses look-ahead (operations.cc:478-533): an entry that
+    does not fit the open bucket is skipped — later same-dtype entries
+    still join that bucket instead of being stranded in new ones."""
+    from horovod_tpu.ops import fusion
+    sizes = [4096, 4096, 100 << 20, 4096]
+    dtypes = ["float32"] * 4
+    for plan in (fusion._python_plan(sizes, dtypes, 64 << 20),
+                 fusion._native_plan(sizes, dtypes, 64 << 20)):
+        assert plan[0] == plan[1] == plan[3], plan  # smalls fused together
+        assert plan[2] != plan[0], plan             # oversized rides alone
+
+
 def test_cache_lru_eviction():
     L = lib()
     c = L.hvd_cache_create(3)
