@@ -76,7 +76,11 @@ int64_t hvd_plan_buckets(int64_t n, const int64_t* nbytes,
     }
     if (!placed) {
       bucket_out[i] = next_id;
-      buckets.push_back(Open{next_id, nbytes[i]});
+      // full/oversized buckets can never accept another tensor; keeping
+      // them open would make planning quadratic in their count
+      if (nbytes[i] < threshold) {
+        buckets.push_back(Open{next_id, nbytes[i]});
+      }
       ++next_id;
     }
   }

@@ -655,8 +655,10 @@ class EagerCoordinator:
             for n in names:
                 tl.end_activity(n)
                 tl.start_activity(n, timeline_mod.ALLREDUCE)
-        gathered = multihost_utils.process_allgather(fused)
-        summed = jnp.sum(jnp.asarray(gathered), axis=0)
+        with jax.profiler.TraceAnnotation(
+                f"hvd.fused_allreduce.x{len(entries)}"):
+            gathered = multihost_utils.process_allgather(fused)
+            summed = jnp.sum(jnp.asarray(gathered), axis=0)
         if average:
             summed = summed / jax.process_count()
         if tl:
@@ -786,18 +788,23 @@ class EagerCoordinator:
                     if len(self._verified_sigs) >= 65536:
                         self._verified_sigs.clear()
                     self._verified_sigs.add(vkey)
-            if op == ALLREDUCE:
-                entry.result = self._allreduce_one(entry, entry_kind)
-            elif op == ALLGATHER:
-                entry.result = self._allgather_one(entry, entry_kind)
-            elif op == BROADCAST:
-                entry.result = self._broadcast_one(entry, entry_kind)
-            elif op == REDUCESCATTER:
-                entry.result = self._reducescatter_one(entry, entry_kind)
-            elif op == ALLTOALL:
-                entry.result = self._alltoall_one(entry, entry_kind)
-            else:
-                raise ValueError(f"Unknown op {op}")
+            # TraceAnnotation places this host-side span inline with the
+            # XLA device events when a jax.profiler trace is active
+            # (utils/timeline.py profile(); SURVEY "timeline fidelity")
+            with jax.profiler.TraceAnnotation(f"hvd.{op}.{entry.name}"):
+                if op == ALLREDUCE:
+                    entry.result = self._allreduce_one(entry, entry_kind)
+                elif op == ALLGATHER:
+                    entry.result = self._allgather_one(entry, entry_kind)
+                elif op == BROADCAST:
+                    entry.result = self._broadcast_one(entry, entry_kind)
+                elif op == REDUCESCATTER:
+                    entry.result = self._reducescatter_one(entry,
+                                                           entry_kind)
+                elif op == ALLTOALL:
+                    entry.result = self._alltoall_one(entry, entry_kind)
+                else:
+                    raise ValueError(f"Unknown op {op}")
         finally:
             if sync_params:
                 self._sync_tuned_params()

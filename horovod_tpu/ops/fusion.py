@@ -102,7 +102,11 @@ def _python_plan(sizes, dtypes, threshold):
                 break
         else:
             assignment.append(next_id)
-            buckets.append((next_id, nb))
+            if nb < threshold:
+                # full/oversized buckets can never accept another tensor;
+                # keeping them in the open list would make planning
+                # quadratic in the oversized-tensor count
+                buckets.append((next_id, nb))
             next_id += 1
     return assignment
 

@@ -33,8 +33,6 @@ import socketserver
 import threading
 import time
 
-import numpy as np
-
 from ..common import hvd_logging as log
 from ..run import network, secret
 

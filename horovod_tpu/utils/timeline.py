@@ -18,6 +18,7 @@ Events use the Chrome trace "ph" codes the reference emits: "M" metadata,
 "B"/"E" begin/end, "i" instant (timeline.cc WriteEvent).
 """
 
+import contextlib
 import json
 import os
 import queue
@@ -176,3 +177,24 @@ def create_from_env(config, is_coordinator):
             pass
     return Timeline(config.timeline_filename,
                     mark_cycles=config.timeline_mark_cycles)
+
+
+@contextlib.contextmanager
+def profile(logdir):
+    """Capture a jax.profiler device trace (TensorBoard/XProf) over the
+    context. Eager collectives executed inside it carry
+    ``hvd.<op>.<name>`` TraceAnnotations, so the host-side spans the
+    Horovod timeline records appear inline with the XLA device events —
+    the correlation the reference achieves by replaying CUDA stream
+    events into the timeline (cuda_operations.cc:69-93; SURVEY "timeline
+    fidelity").
+
+        with hvd.utils.timeline.profile("/tmp/jax-trace"):
+            ... training steps / eager collectives ...
+    """
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

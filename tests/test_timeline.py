@@ -21,6 +21,16 @@ def hvd_timeline(monkeypatch, tmp_path):
     hvd_mod.shutdown()
 
 
+class TestProfilerIntegration:
+    def test_profile_context_writes_trace(self, hvd, tmp_path):
+        from horovod_tpu.utils.timeline import profile
+        logdir = tmp_path / "trace"
+        with profile(str(logdir)):
+            hvd.allreduce(np.ones((8, 2)), average=False, name="prof.op")
+        written = list(logdir.rglob("*"))
+        assert any(p.is_file() for p in written), written
+
+
 class TestTimeline:
     def test_spans_written(self, hvd_timeline):
         hvd, path = hvd_timeline
