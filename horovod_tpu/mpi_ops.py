@@ -22,7 +22,7 @@ import itertools
 import jax
 
 from .common import state as state_mod
-from .common.exceptions import NotInitializedError
+from .common.exceptions import HorovodError, NotInitializedError
 from .ops import collective_ops as cops
 from .ops import eager as eager_mod
 from .ops.compression import Compression
@@ -62,12 +62,49 @@ def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
     # way mpirun exports OMPI_COMM_WORLD_* for the reference
     # (test/common.py:25-57). Explicit args win over env.
     import os
+    def _env_first(*names, default):
+        for n in names:
+            if n in os.environ:
+                return int(os.environ[n])
+        return default
+
+    def _jax_distributed_live():
+        try:  # pre-initialized by the caller (the pods flow)
+            from jax._src import distributed
+            return distributed.global_state.coordinator_address is not None
+        except Exception:  # noqa: BLE001 — private API may move
+            return False
+
     if coordinator_address is None and "HVD_COORDINATOR_ADDR" in os.environ:
         coordinator_address = os.environ["HVD_COORDINATOR_ADDR"]
-        num_processes = (num_processes if num_processes is not None
-                         else int(os.environ.get("HVD_NUM_PROC", "1")))
-        process_id = (process_id if process_id is not None
-                      else int(os.environ.get("HVD_PROCESS_ID", "0")))
+        if num_processes is None:
+            # hvdrun's env first, then mpirun/srun's (reference jobs read
+            # OMPI_COMM_WORLD_* / PMI_*, test/common.py:25-57) — so
+            # `mpirun -np N python train.py` works with only
+            # HVD_COORDINATOR_ADDR exported
+            num_processes = _env_first("HVD_NUM_PROC",
+                                       "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                                       default=1)
+        if process_id is None:
+            process_id = _env_first("HVD_PROCESS_ID",
+                                    "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                                    default=0)
+    elif coordinator_address is None and num_processes is None:
+        # mpirun/srun compatibility: reference jobs launch under MPI and
+        # read OMPI_COMM_WORLD_* / PMI_* (test/common.py:25-57). Honor the
+        # same ranks here so `mpirun -np N python train.py` migrates —
+        # the rendezvous address still must come from HVD_COORDINATOR_ADDR
+        # (MPI exports no equivalent; hvdrun/run(fn)/spark set it), unless
+        # the caller bootstrapped jax.distributed itself (TPU pods).
+        mpi_size = os.environ.get("OMPI_COMM_WORLD_SIZE",
+                                  os.environ.get("PMI_SIZE"))
+        if mpi_size is not None and int(mpi_size) > 1 and \
+                not _jax_distributed_live():
+            raise HorovodError(
+                "MPI launch detected (world size "
+                f"{mpi_size}) but no rendezvous address: export "
+                "HVD_COORDINATOR_ADDR=host:port of rank 0 (mpirun does "
+                "not provide one), or launch with hvdrun")
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -43,3 +43,41 @@ def test_rank_inside_shard_map(hvd):
     out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("hvd"),
                                 out_specs=P("hvd")))(jnp.zeros(8))
     assert list(out) == list(range(8))
+
+
+class TestMpirunCompat:
+    def test_mpi_env_without_rendezvous_raises_helpfully(self, monkeypatch):
+        """mpirun-launched jobs (reference OMPI_COMM_WORLD_* env,
+        test/common.py:25-57) get a clear pointer to
+        HVD_COORDINATOR_ADDR instead of silently initializing
+        single-process."""
+        import horovod_tpu as hvd_mod
+        monkeypatch.delenv("HVD_COORDINATOR_ADDR", raising=False)
+        monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+        with pytest.raises(hvd_mod.HorovodError,
+                           match="HVD_COORDINATOR_ADDR"):
+            hvd_mod.init()
+
+    def test_mpi_ranks_honored_with_rendezvous(self, monkeypatch):
+        """With the rendezvous exported, OMPI ranks feed
+        jax.distributed.initialize."""
+        import horovod_tpu.mpi_ops as mpi_ops
+        for k in ("HVD_NUM_PROC", "HVD_PROCESS_ID", "PMI_SIZE",
+                  "PMI_RANK"):
+            monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv("HVD_COORDINATOR_ADDR", "127.0.0.1:43210")
+        monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+        seen = {}
+
+        def fake_initialize(coordinator_address, num_processes, process_id):
+            seen.update(addr=coordinator_address, n=num_processes,
+                        pid=process_id)
+            raise RuntimeError("stop before real bootstrap")
+
+        monkeypatch.setattr(mpi_ops.jax.distributed, "initialize",
+                            fake_initialize)
+        with pytest.raises(RuntimeError, match="stop before"):
+            mpi_ops.init()
+        assert seen == {"addr": "127.0.0.1:43210", "n": 4, "pid": 3}
