@@ -153,6 +153,30 @@ class TestAnyOrderSubmission:
         for res in results:
             assert res == {"A": 2.0, "B": 4.0}, results
 
+    def test_three_ranks_rotated_orders(self):
+        """Three processes submit the same three tensors, each in a
+        different rotation — the coordinator serializes them all."""
+        def fn():
+            import os
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            names = ["X", "Y", "Z"]
+            order = names[r:] + names[:r]  # rotate by rank
+            handles = {n: hvd.allreduce_async(
+                np.full((2,), float(ord(n)), np.float32),
+                average=True, name=n) for n in order}
+            out = {n: float(np.asarray(hvd.synchronize(h))[0])
+                   for n, h in handles.items()}
+            hvd.shutdown()
+            return out
+
+        results = run(fn, num_proc=3, env=_ENV)
+        want = {n: float(ord(n)) for n in "XYZ"}
+        for res in results:
+            assert res == want, results
+
     def test_burst_is_fused_by_coordinator(self):
         def fn():
             import numpy as np
