@@ -12,6 +12,12 @@ python setup.py build_native
 echo "--- unit + integration tests (8-device virtual mesh)"
 python -m pytest tests/ -q
 
+echo "--- driver contract: env-free multi-chip dryrun"
+# Must pass with NO env vars pre-set (the driver runs it exactly this way
+# on a 1-chip host); dryrun_multichip self-provisions the virtual mesh.
+env -u XLA_FLAGS -u JAX_PLATFORMS \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
 echo "--- example smoke tests"
 make examples
 
