@@ -638,29 +638,34 @@ class EagerCoordinator:
             e.status = exc
             e.event.set()
 
+    @functools.cached_property
+    def _proc_engine(self):
+        """Device-side cross-process collective engine (one bandwidth-
+        optimal XLA collective per op — ops/process_collectives.py)."""
+        from .process_collectives import ProcessCollectiveEngine
+        return ProcessCollectiveEngine()
+
     def _exec_fused_replicated_allreduce(self, entries, average):
         """Coordinator-fused multi-process allreduce: one flattened
-        buffer, ONE cross-process collective for the whole bucket
-        (MPIAllreduce's fusion-buffer memcpy-in/allreduce/memcpy-out,
-        mpi_operations.cc:25-66, on the process axis)."""
-        from jax.experimental import multihost_utils
+        buffer, ONE cross-process device-side collective for the whole
+        bucket (MPIAllreduce's fusion-buffer memcpy-in/allreduce/
+        memcpy-out, mpi_operations.cc:25-66, on the process axis).
+        Concat, psum, and un-fuse slicing all happen on device — the
+        host never stages the payload."""
         tl = self.timeline
         names = [e.name for e in entries]
         if tl:
             for n in names:
                 tl.start_activity(n, timeline_mod.MEMCPY_IN_FUSION_BUFFER)
-        flats = [np.asarray(e.tensor).reshape(-1) for e in entries]
-        fused = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        flats = [jnp.reshape(jnp.asarray(e.tensor), (-1,)) for e in entries]
+        fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         if tl:
             for n in names:
                 tl.end_activity(n)
                 tl.start_activity(n, timeline_mod.ALLREDUCE)
         with jax.profiler.TraceAnnotation(
                 f"hvd.fused_allreduce.x{len(entries)}"):
-            gathered = multihost_utils.process_allgather(fused)
-            summed = jnp.sum(jnp.asarray(gathered), axis=0)
-        if average:
-            summed = summed / jax.process_count()
+            summed = self._proc_engine.allreduce(fused, average=average)
         if tl:
             for n in names:
                 tl.end_activity(n)
@@ -916,13 +921,8 @@ class EagerCoordinator:
         # replicated: participants are host processes.
         if jax.process_count() == 1:
             return jnp.asarray(entry.tensor)
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(
-            jnp.asarray(entry.tensor))
-        out = jnp.sum(gathered, axis=0)
-        if entry.average:
-            out = out / jax.process_count()
-        return out
+        return self._proc_engine.allreduce(entry.tensor,
+                                           average=entry.average)
 
     def _allgather_one(self, entry, kind):
         if kind == "list":
@@ -937,20 +937,20 @@ class EagerCoordinator:
             return jnp.asarray(entry.tensor)
         # cross-process allgatherv: first dims may differ per rank
         # (MPI_Allgatherv recvcounts/displacements, mpi_operations.cc:142;
-        # output math collective_operations.cc:68-105). process_allgather
+        # output math collective_operations.cc:68-105). The device gather
         # needs equal shapes, so exchange dim0 sizes, pad to the max,
         # gather, then slice each rank's true extent back out.
-        from jax.experimental import multihost_utils
+        eng = self._proc_engine
         t = jnp.asarray(entry.tensor)
         if t.ndim == 0:
-            return multihost_utils.process_allgather(t)  # → [nproc]
-        counts = np.asarray(multihost_utils.process_allgather(
+            return eng.allgather_stacked(t)  # → [nproc]
+        counts = np.asarray(eng.allgather_stacked(
             np.asarray([t.shape[0]], np.int32)))[:, 0]
         max0 = int(counts.max())
         if t.shape[0] < max0:
             pad = jnp.zeros((max0 - t.shape[0],) + t.shape[1:], t.dtype)
             t = jnp.concatenate([t, pad], axis=0)
-        gathered = multihost_utils.process_allgather(t)
+        gathered = eng.allgather_stacked(t)
         if (counts == max0).all():
             return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
         return jnp.concatenate(
@@ -963,10 +963,8 @@ class EagerCoordinator:
             return self._replicate(self._stacked_bcast(x, int(entry.root_rank)))
         if jax.process_count() == 1:
             return jnp.asarray(entry.tensor)
-        from jax.experimental import multihost_utils
-        return multihost_utils.broadcast_one_to_all(
-            jnp.asarray(entry.tensor),
-            is_source=jax.process_index() == entry.root_rank)
+        return self._proc_engine.broadcast(entry.tensor,
+                                           int(entry.root_rank))
 
     def _reducescatter_one(self, entry, kind):
         """Each worker gets its 1/world shard of the elementwise-summed
@@ -993,12 +991,15 @@ class EagerCoordinator:
         t = jnp.asarray(entry.tensor)
         if jax.process_count() == 1:
             return t
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(t)
-        summed = jnp.sum(gathered, axis=0)
-        if entry.average:
-            summed = summed / jax.process_count()
-        return scatter(summed, t.shape)[jax.process_index()]
+        # device-side psum_scatter: this process receives ONLY its
+        # 1/nproc shard over the wire (the real reducescatter contract,
+        # nccl_operations.cc:269 — not a full allgather)
+        if t.shape[0] % world:
+            raise MismatchError(
+                f"reducescatter '{entry.name}': first dim {t.shape[0]} "
+                f"not divisible by world size {world}.")
+        shard = self._proc_engine.reducescatter(t, average=entry.average)
+        return jnp.reshape(shard, (t.shape[0] // world,) + t.shape[1:])
 
     def _alltoall_one(self, entry, kind):
         """Worker j's chunk i goes to worker i (MPI_Alltoall semantics;
@@ -1023,13 +1024,10 @@ class EagerCoordinator:
             raise MismatchError(
                 f"alltoall '{entry.name}': first dim ({t.shape[0]}) not "
                 f"divisible by world size {world}.")
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(t)  # [P, d0, ...]
-        k = t.shape[0] // world
-        me = jax.process_index()
-        # my output = concat_j gathered[j]'s chunk me
-        return jnp.concatenate(
-            [gathered[j, me * k:(me + 1) * k] for j in range(world)], axis=0)
+        # device-side lax.all_to_all: each pairwise chunk crosses the
+        # wire exactly once (O(M) per process, not the O(P·M) a full
+        # allgather would move)
+        return self._proc_engine.alltoall(t)
 
     def _check_gather_shapes(self, name, tensors):
         """Allgather rank/dim checks (ConstructResponse,
