@@ -1,4 +1,4 @@
-"""Headline benchmark: ResNet-50 synthetic images/sec on the local chip(s).
+"""Headline benchmark: ResNet-50 images/sec + flagship transformer MFU.
 
 Parity with the reference harness (examples/pytorch_synthetic_benchmark.py:
 ResNet-50, synthetic ImageNet-shaped data, 10 warmup batches, 10 iters x 10
@@ -7,11 +7,19 @@ single-GPU Pascal P100 ResNet-50 fp32 throughput (~219 img/sec) underlying
 the reference's 512-GPU scaling chart (docs/benchmarks.md:6-7) — the
 per-worker number our per-chip number must beat.
 
-The model/step recipe and warmup+timed-iteration protocol live in
+The same line also carries the flagship transformer LM (GPT-2-small,
+Pallas flash attention, bf16, seq 1024): tokens/sec/chip and measured
+MFU. MFU uses the matmul-FLOPs convention (PaLM appendix B):
+``flops/token = 6·P_matmul + 12·L·seq·d_model`` against the chip's peak
+bf16 rate (bench_common.transformer_matmul_flops_per_token — P_matmul
+includes all three gated-MLP kernels).
+
+The model/step recipes and timing protocols live in
 examples/bench_common.py, shared with examples/{synthetic,scaling}_benchmark
 so the harnesses cannot drift.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"transformer_lm": {...}}.
 """
 
 import json
@@ -24,6 +32,24 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
 
 
 BASELINE_IMG_PER_SEC_PER_WORKER = 219.0  # P100 ResNet-50, reference baseline
+
+# peak dense bf16 matmul throughput per chip, by device_kind prefix
+_PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,        # trillium
+}
+
+
+def _peak_flops(device):
+    kind = getattr(device, "device_kind", "")
+    # longest matching prefix ("TPU v5 lite" must win over "TPU v5")
+    best = None
+    for k, v in _PEAK_BF16_FLOPS.items():
+        if kind.startswith(k) and (best is None or len(k) > best[0]):
+            best = (len(k), v)
+    return best[1] if best else None
 
 
 def main():
@@ -66,12 +92,25 @@ def main():
             print(f"batch {cand}/chip OOM, trying smaller", file=sys.stderr)
 
     img_sec_per_chip = float(np.mean(rates)) / n_chips
+
+    # free the ResNet step before compiling the transformer
+    step = params = opt_state = batch_data = None
+    jax.clear_caches()
+    try:
+        from bench_common import bench_transformer_lm
+        peak = _peak_flops(jax.devices()[0]) if on_tpu else None
+        tlm = bench_transformer_lm(on_tpu, peak_flops=peak)
+    except Exception as e:  # noqa: BLE001 — ResNet line must still print
+        print(f"transformer bench failed: {e}", file=sys.stderr)
+        tlm = {"error": str(e)[:200]}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_sec_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(
             img_sec_per_chip / BASELINE_IMG_PER_SEC_PER_WORKER, 3),
+        "transformer_lm": tlm,
     }))
     return 0
 

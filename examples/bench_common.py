@@ -83,3 +83,70 @@ def positive_int(value):
     if v < 1:
         raise ValueError(f"expected a positive count, got {value}")
     return v
+
+
+def transformer_matmul_flops_per_token(cfg, seq):
+    """Matmul FLOPs per token, PaLM appendix-B convention:
+    ``6·P_matmul + 12·L·seq·d_model``. P_matmul counts qkv+out projections
+    (4·d²), the gated SwiGLU MLP (THREE d×d_ff kernels: gate/up/down —
+    models/transformer.py MLP), and the lm_head."""
+    p_matmul = (cfg.num_layers * (4 * cfg.d_model ** 2 +
+                                  3 * cfg.d_model * cfg.d_ff) +
+                cfg.d_model * cfg.vocab_size)
+    return 6 * p_matmul + 12 * cfg.num_layers * seq * cfg.d_model
+
+
+def bench_transformer_lm(on_tpu, peak_flops=None):
+    """Timed flagship-transformer training window (the canonical source
+    of the tokens/sec/chip + MFU numbers in bench.py's JSON line and
+    docs/benchmarks.md — keep single-sourced so harnesses cannot drift).
+    Returns a metrics dict."""
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.parallel import mesh as mesh_mod
+
+    if on_tpu:
+        cfg = tr.TransformerConfig.gpt2_small(attention_impl="flash")
+        batch_per_chip, seq, steps = 8, 1024, 20
+    else:  # CI smoke on CPU: tiny everything, no MFU claim
+        cfg = tr.TransformerConfig.tiny(attention_impl="full")
+        batch_per_chip, seq, steps = 2, 64, 3
+
+    n = hvd.size()
+    mesh = mesh_mod.build_mesh(dp=n)
+    model = tr.TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    batch = batch_per_chip * n
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, seq), jnp.int32))["params"]
+    tx = optax.adamw(3e-4)
+    step, pshard, bshard = trainer.make_gspmd_step(
+        tr.lm_loss_fn(model), tx, mesh, tr.param_specs(params),
+        tr.batch_spec(), params=params)
+    params = jax.tree_util.tree_map(jax.device_put, params, pshard)
+    opt_state = trainer.init_opt_state(tx, params, mesh,
+                                       tr.param_specs(params))
+    toks = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq),
+                                dtype=np.int64).astype(np.int32)), bshard)
+    params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)  # scalar read = true barrier on remote-attached runtimes
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tps_chip = batch * seq * steps / dt / n
+
+    flops_per_token = transformer_matmul_flops_per_token(cfg, seq)
+    mfu = (tps_chip * flops_per_token / peak_flops) if peak_flops else None
+    return {
+        "model": f"gpt2-small-{'flash' if on_tpu else 'tiny-smoke'}",
+        "tokens_per_sec_per_chip": round(tps_chip, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "seq_len": seq,
+        "batch_per_chip": batch_per_chip,
+        "ms_per_step": round(dt * 1e3 / steps, 2),
+    }

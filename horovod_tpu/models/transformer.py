@@ -251,7 +251,7 @@ def batch_spec(sp=False):
 
 
 def chunked_softmax_cross_entropy(hidden, head_kernel, targets,
-                                  chunk=8192):
+                                  chunk=8192, weights=None):
     """Mean next-token cross entropy WITHOUT materializing the
     [B, S, vocab] logits: a ``lax.scan`` over vocab chunks of the lm_head
     matmul with an online (running max + sum-exp) logsumexp, rematerialized
@@ -303,7 +303,11 @@ def chunked_softmax_cross_entropy(hidden, head_kernel, targets,
     (m, s, tgt_logit), _ = lax.scan(
         jax.checkpoint(body, prevent_cse=False), init,
         (kc, jnp.arange(n, dtype=jnp.int32) * chunk))
-    return jnp.mean(m + jnp.log(s) - tgt_logit)
+    nll = m + jnp.log(s) - tgt_logit
+    if weights is None:
+        return jnp.mean(nll)
+    weights = weights.astype(nll.dtype)
+    return jnp.sum(nll * weights) / jnp.sum(weights)
 
 
 def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
@@ -324,7 +328,19 @@ def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
     from .. import trainer as trainer_mod
 
     def loss_fn(params, tokens):
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        # Full-length inputs keep the sequence dim tile-aligned: a 1024
+        # sequence runs every matmul at 1024, where the classic
+        # inputs[:-1]/targets[1:] split runs at 1023 and XLA pads each
+        # (8, 128) tile (~8% step time on v5e, see docs/benchmarks.md).
+        # The final position gets a rolled dummy target with zero
+        # weight; causal masking makes the other positions' outputs
+        # independent of the extra input token, so for dense configs the
+        # loss is identical to the shifted split. For MoE the router's
+        # load-balance statistics intentionally include the final token
+        # (it is a real token — only its CE target is unknowable here).
+        inputs = tokens
+        targets = jnp.roll(tokens, -1, axis=1)
+        weights = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
         if model.cfg.num_experts > 0:
             from .moe import aux_loss_from
             if vocab_chunk:
@@ -333,20 +349,21 @@ def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
                                           mutable=["losses"])
                 ce = chunked_softmax_cross_entropy(
                     hidden, params["lm_head"]["kernel"], targets,
-                    chunk=vocab_chunk)
+                    chunk=vocab_chunk, weights=weights)
             else:
                 logits, mut = model.apply({"params": params}, inputs,
                                           mutable=["losses"])
-                ce = trainer_mod.softmax_cross_entropy(logits, targets)
+                ce = trainer_mod.softmax_cross_entropy(logits, targets,
+                                                       weights)
             return ce + aux_loss_from(mut, weight=aux_weight)
         if vocab_chunk:
             hidden = model.apply({"params": params}, inputs,
                                  return_hidden=True)
             return chunked_softmax_cross_entropy(
                 hidden, params["lm_head"]["kernel"], targets,
-                chunk=vocab_chunk)
+                chunk=vocab_chunk, weights=weights)
         logits = model.apply({"params": params}, inputs)
-        return trainer_mod.softmax_cross_entropy(logits, targets)
+        return trainer_mod.softmax_cross_entropy(logits, targets, weights)
     return loss_fn
 
 

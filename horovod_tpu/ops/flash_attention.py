@@ -8,7 +8,8 @@ VMEM residency is O(block·d) regardless of sequence length:
 
   * logits tiles computed with ``jnp.dot(..., preferred_element_type=
     fp32)`` → MXU at full precision for the softmax math
-  * block sizes default to 128 (MXU-native); the lane dim is head_dim
+  * block sizes default to 512 (measured fastest on v5e; see
+    flash_attention's docstring); the lane dim is head_dim
   * causal masking per tile from broadcasted iotas, and the K-block loop
     stops at the diagonal (dynamic fori bound), skipping the ~half of
     tiles that are fully in the future
@@ -41,6 +42,13 @@ def _auto_interpret():
     return jax.default_backend() != "tpu"
 
 
+# both grid dims are independent (programs share no state): 'parallel'
+# lets Mosaic software-pipeline across grid steps instead of flushing
+# between them
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel"))
+
+
 def _stream(hbm, bh, block, scr, sem, seq_axis=1):
     """Double-buffered HBM→VMEM tile stream: returns ``dma(slot, i)`` for
     tile i of ``hbm[bh]`` (``block`` rows along ``seq_axis``) into scratch
@@ -70,7 +78,10 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
-    q = q_ref[0].astype(jnp.float32)            # [block_q, d]
+    # matmul operands stay in the input dtype (bf16 runs the MXU at full
+    # rate; fp32 would quarter it on v5e) — accumulation is fp32 via
+    # preferred_element_type, softmax statistics are fp32 throughout
+    q = q_ref[0]                                # [block_q, d]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
@@ -97,8 +108,8 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
                 _start_all(streams, (kb + 1) % 2, kb + 1)
 
             _wait_all(streams, slot, kb)
-            k = k_scr[slot].astype(jnp.float32)
-            v = v_scr[slot].astype(jnp.float32)
+            k = k_scr[slot]
+            v = v_scr[slot]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
             if causal:
                 k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -109,7 +120,7 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
             alpha = jnp.exp(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1)
             acc = acc * alpha[:, None] + jnp.dot(
-                p, v, preferred_element_type=jnp.float32)
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
             return m_new, l, acc
 
         init = (jnp.full((block_q,), _NEG_INF, jnp.float32),
@@ -153,6 +164,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
+        compiler_params=_COMPILER_PARAMS,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             # K/V stay in HBM; the kernel DMAs block_k tiles into
@@ -181,8 +193,8 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]               # input dtype into the MXU (see _fwd_kernel)
+    do = do_ref[0]
     lse = lse_ref[0, 0]        # row 0 of the 8-way replicated sublane dim
     delta = delta_ref[0, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -208,8 +220,8 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
                 _start_all(streams, (kb + 1) % 2, kb + 1)
 
             _wait_all(streams, slot, kb)
-            k = k_scr[slot].astype(jnp.float32)
-            v = v_scr[slot].astype(jnp.float32)
+            k = k_scr[slot]
+            v = v_scr[slot]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
             if causal:
                 k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -217,7 +229,7 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
                 s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * scale
+            ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
             return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
         dq = jax.lax.fori_loop(0, nk, body,
@@ -239,8 +251,8 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     d = k_ref.shape[-1]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]               # input dtype into the MXU (see _fwd_kernel)
+    v = v_ref[0]
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
 
@@ -270,8 +282,8 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
                 _start_all(streams, (qb + 1) % 2, qb + 1)
 
             _wait_all(streams, slot, qb)
-            q = q_scr[slot].astype(jnp.float32)
-            do = do_scr[slot].astype(jnp.float32)
+            q = q_scr[slot]
+            do = do_scr[slot]
             lse = lse_scr[slot, 0]     # row 0 of the replicated sublanes
             delta = delta_scr[slot, 0]
 
@@ -281,10 +293,10 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
                     jnp.int32, (block_q, block_k), 0)
                 s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
             p = jnp.exp(s - lse[:, None])                  # [bq, bk]
-            dv = dv + jnp.dot(p.T, do,
+            dv = dv + jnp.dot(p.astype(do.dtype).T, do,
                               preferred_element_type=jnp.float32)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * scale
+            ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
             dk = dk + jnp.dot(ds.T, q,
                               preferred_element_type=jnp.float32)
             return dk, dv
@@ -332,6 +344,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
         functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
                           seq_k=sk, causal=causal, scale=scale),
         grid=(b * h, sq // block_q),
+        compiler_params=_COMPILER_PARAMS,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -349,6 +362,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
         functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
                           seq_q=sq, causal=causal, scale=scale),
         grid=(b * h, sk // block_k),
+        compiler_params=_COMPILER_PARAMS,
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -381,14 +395,14 @@ def _flash_core(q, k, v, causal, block_q, block_k, interpret, scale):
     return out
 
 
-def flash_attention(q, k, v, causal=True, block_q=256, block_k=256,
+def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
                     interpret=None):
     """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
     global positions. Numerically equivalent to
     parallel.ring.full_attention (exact softmax, fp32 accumulation), in
-    forward and backward, with O(s·d) memory in both. Default 256-blocks
-    measured fastest on v5e (seq 4096: fwd 12.4 ms, fwd+bwd 18.9 ms vs
-    14.4/32.5 at 128).
+    forward and backward, with O(s·d) memory in both. Default 512-blocks
+    measured fastest on v5e (b8 s1024 h12 d64, 12 layers fwd+bwd:
+    34.7 ms at 512 vs 76.8 ms at 128; XLA full attention 49.4 ms).
 
     Sequence lengths need not divide the block sizes for causal
     self-attention (sq == sk): inputs are end-padded to the next block

@@ -24,11 +24,15 @@ from . import optim
 from .ops.compression import Compression
 
 
-def softmax_cross_entropy(logits, labels):
-    """Mean token-level cross entropy (labels are int ids)."""
+def softmax_cross_entropy(logits, labels, weights=None):
+    """Mean token-level cross entropy (labels are int ids). ``weights``
+    (same shape as labels) masks positions out of the mean."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    if weights is None:
+        return -jnp.mean(ll)
+    weights = weights.astype(ll.dtype)
+    return -jnp.sum(ll * weights) / jnp.sum(weights)
 
 
 def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
