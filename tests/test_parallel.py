@@ -67,3 +67,65 @@ def test_hierarchical_allreduce_padding(hvd):
         out_specs=P(("slices", "chips"))))(x)
     np.testing.assert_allclose(np.asarray(out).reshape(8, 7)[3],
                                x.mean(axis=0), rtol=1e-6)
+
+
+def test_hierarchical_allreduce_hlo_reduces_slow_axis_bytes(hvd):
+    """The perf contract of the two-level path (the reference's most
+    perf-critical op, nccl_operations.cc:162-379): from the COMPILED HLO,
+    the inter-slice (slow/DCN) collective must operate on 1/chips_per_slice
+    of the payload, between cross-slice replica groups — while the flat
+    allreduce moves the full payload through one global group."""
+    import re
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel import hierarchical, mesh as mesh_mod
+
+    m = mesh_mod.build_hierarchical_mesh(num_slices=2)  # 2 slices x 4 chips
+    n = 1024
+    chips = m.shape["chips"]
+    x = np.zeros((8, n), np.float32)
+
+    def collectives(fn):
+        """[(op, elements, replica_groups)] from the optimized HLO."""
+        j = jax.jit(jax.shard_map(
+            fn, mesh=m, in_specs=P(("slices", "chips")),
+            out_specs=P(("slices", "chips"))))
+        hlo = j.lower(x).compile().as_text()
+        out = []
+        pat = re.compile(
+            r"f32\[(\d+)\]\S*\s+(all-reduce|reduce-scatter|all-gather)\("
+            r".*?replica_groups=\{(\{[\d,{}]+\})\}")
+        for line in hlo.splitlines():
+            match = pat.search(line)
+            if match:
+                groups = [
+                    tuple(int(i) for i in g.split(","))
+                    for g in re.findall(r"\{([\d,]+)\}", match.group(3))]
+                out.append((match.group(2), int(match.group(1)), groups))
+        return out
+
+    def hier(s):
+        return hierarchical.hierarchical_allreduce(
+            s[0], fast_axis="chips", slow_axis="slices")[None]
+
+    def flat(s):
+        return hierarchical.flat_allreduce(s[0], ("slices", "chips"))[None]
+
+    intra = [(0, 1, 2, 3), (4, 5, 6, 7)]      # fast axis: within a slice
+    cross = [(0, 4), (1, 5), (2, 6), (3, 7)]  # slow axis: across slices
+
+    ops = collectives(hier)
+    by_op = {op: (elems, groups) for op, elems, groups in ops}
+    assert set(by_op) == {"reduce-scatter", "all-reduce", "all-gather"}, ops
+    # phase 1: reduce-scatter over ICI leaves each chip 1/chips of the data
+    assert by_op["reduce-scatter"] == (n // chips, intra), ops
+    # phase 2 — THE point: the slow-axis collective carries only n/chips
+    assert by_op["all-reduce"] == (n // chips, cross), ops
+    # phase 3: all-gather over ICI rebuilds the full tensor
+    assert by_op["all-gather"][0] == n and by_op["all-gather"][1] == intra
+
+    flat_ops = collectives(flat)
+    assert flat_ops == [
+        ("all-reduce", n, [(0, 1, 2, 3, 4, 5, 6, 7)])], flat_ops
