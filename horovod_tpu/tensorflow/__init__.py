@@ -198,6 +198,76 @@ def _allreduce_grads(grads, compression):
             for g, h in zip(grads, handles)]
 
 
+def _ingest_zero_copy(t):
+    """Eager tf.Tensor → jax array without a host copy when possible
+    (both runtimes on CPU share the buffer via the dlpack protocol); the
+    caller must keep ``t`` alive until the collective completes."""
+    import jax
+    try:
+        return jax.dlpack.from_dlpack(t)
+    except Exception:  # noqa: BLE001 — odd dtype/placement: copy instead
+        return np.array(t.numpy(), copy=True)
+
+
+def _graph_fused_allreduce(dense, compression):
+    """The in-graph gradient-averaging route for ``tf.function`` train
+    steps — the role of the reference's AsyncOpKernel inside the graph
+    (tensorflow/mpi_ops.cc:276-304), built from graph ops instead of a
+    custom kernel:
+
+      * the fusion buffer is IN-GRAPH: one ``tf.concat`` per dtype group
+        (FuseResponses groups by dtype too, operations.cc:450-573), so
+        the host boundary sees one tensor per dtype, not one per gradient
+      * ONE ``tf.py_function`` per step crosses to the core; inbound
+        tensors enter jax zero-copy via dlpack, outbound results come
+        back as one buffer per group
+      * ``tf.split`` + ``tf.reshape`` un-fuse in-graph
+
+    A gradient without a fully-static shape cannot enter a fusion buffer
+    (py_function output shapes must be re-attached statically to split);
+    it rides the SAME single host call un-concatenated instead."""
+    import tensorflow as tf
+
+    static = [i for i, g in enumerate(dense)
+              if g.shape.num_elements() is not None]
+    dynamic = [i for i, g in enumerate(dense)
+               if g.shape.num_elements() is None]
+    by_dtype = {}
+    for i in static:
+        by_dtype.setdefault(dense[i].dtype, []).append(i)
+    metas = []   # per fusion buffer: (indices, split sizes)
+    fused = []
+    for idxs in by_dtype.values():
+        flats = [tf.reshape(dense[i], [-1]) for i in idxs]
+        metas.append((idxs, [dense[i].shape.num_elements() for i in idxs]))
+        fused.append(flats[0] if len(flats) == 1
+                     else tf.concat(flats, axis=0))
+    buffers = fused + [dense[i] for i in dynamic]
+
+    def _host(*bufs):
+        handles = [_core.allreduce_async(_ingest_zero_copy(b), average=True,
+                                         name=f"fused_grad.{j}",
+                                         compression=compression,
+                                         kind="replicated")
+                   for j, b in enumerate(bufs)]
+        return [np.asarray(_core.synchronize(h)) for h in handles]
+
+    reduced = tf.py_function(_host, buffers,
+                             Tout=[b.dtype for b in buffers])
+    if not isinstance(reduced, (list, tuple)):
+        reduced = [reduced]
+    outs = [None] * len(dense)
+    for rf, f, (idxs, sizes) in zip(reduced, fused, metas):
+        rf.set_shape(f.shape)
+        parts = tf.split(rf, sizes) if len(idxs) > 1 else [rf]
+        for i, p in zip(idxs, parts):
+            outs[i] = tf.reshape(p, dense[i].shape)
+    for i, r in zip(dynamic, reduced[len(fused):]):
+        r.set_shape(dense[i].shape)  # partial shapes are fine here
+        outs[i] = r
+    return outs
+
+
 def DistributedOptimizer(optimizer, compression=Compression.none):
     """Wrap a Keras optimizer so ``apply_gradients`` first averages the
     gradients across workers (reference DistributedOptimizer overriding
@@ -205,9 +275,11 @@ def DistributedOptimizer(optimizer, compression=Compression.none):
     the seam to apply_gradients).
 
     Inside a compiled ``tf.function`` train step (Keras ``fit``), the
-    allreduce rides ONE ``tf.py_function`` covering every gradient — the
-    role of the reference's custom AsyncOpKernels
-    (tensorflow/mpi_ops.cc:276-304), and a single host call keeps the
+    gradients are fused IN-GRAPH into one buffer per dtype (tf.concat)
+    and cross to the core through ONE ``tf.py_function`` per step with
+    dlpack zero-copy ingestion — the role of the reference's custom
+    AsyncOpKernels (tensorflow/mpi_ops.cc:276-304); see
+    _graph_fused_allreduce. The single host call also keeps the
     collective order identical on all workers regardless of TF's graph
     scheduling. py_function cannot be lowered by XLA: pass
     ``jit_compile=False`` to ``model.compile`` on hosts with accelerators
@@ -240,16 +312,8 @@ def DistributedOptimizer(optimizer, compression=Compression.none):
             if tf.executing_eagerly():
                 reduced = _allreduce_grads(dense, self._hvd_compression)
             else:
-                comp = self._hvd_compression
-
-                def _host_allreduce(*flat):
-                    return _allreduce_grads(list(flat), comp)
-
-                reduced = tf.py_function(
-                    _host_allreduce, dense,
-                    Tout=[g.dtype for g in dense])
-                for r, g in zip(reduced, dense):
-                    r.set_shape(g.shape)
+                reduced = _graph_fused_allreduce(dense,
+                                                 self._hvd_compression)
             for i, r in zip(present, reduced):
                 grads[i] = r
             grads_and_vars = list(zip(grads, variables))
