@@ -173,6 +173,12 @@ class PlanCache:
 class EagerCoordinator:
     """The per-process coordination core (BackgroundThreadLoop analogue)."""
 
+    # how long the control plane must stay unreachable (with >=3 failed
+    # attempts under exponential backoff) before this worker declares it
+    # lost and fails pending work — transient coordinator pauses or TCP
+    # resets must not tear the job down at cycle cadence
+    POISON_GRACE_S = 5.0
+
     def __init__(self, state):
         self._state = state
         self._config = state.config
@@ -201,6 +207,8 @@ class EagerCoordinator:
         self._negotiated_pending = {}  # name -> entry awaiting a response
         self._applied_seq = -1
         self._cycle_failures = 0
+        self._cycle_fail_since = None   # first failure of current streak
+        self._cycle_backoff_until = 0.0
         self._cycle_req_id = 0
         self._negotiation_dead = False
         self._unannounced = []  # metas not yet delivered to the coordinator
@@ -479,6 +487,8 @@ class EagerCoordinator:
             self._fail_pending_negotiated(ShutdownError(
                 "negotiation control plane lost"))
             return
+        if time.monotonic() < self._cycle_backoff_until:
+            return  # exponential backoff after control-plane failures
         # Announcements survive transient control-plane failures: a retry
         # resends the SAME request id + metas, and the coordinator dedupes
         # on the id — a response lost after the server processed it must
@@ -514,13 +524,23 @@ class EagerCoordinator:
                                           req_id=self._cycle_req_id)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = metas
+            now = time.monotonic()
             self._cycle_failures += 1
-            if self._cycle_failures >= 3:
-                # The coordinator is gone (rank 0 exited/crashed): fail
-                # pending work with a clear error instead of hanging, try
-                # to tell the control plane so peers are released rather
-                # than left blocked in matching collectives, and poison
-                # this coordinator — continuing to negotiate after
+            if self._cycle_fail_since is None:
+                self._cycle_fail_since = now
+            # exponential backoff between retries (50 ms → 1.6 s): three
+            # instant connection-resets at the 5 ms cycle cadence must
+            # not tear the job down within ~15 ms
+            self._cycle_backoff_until = now + min(
+                0.05 * (2 ** min(self._cycle_failures - 1, 5)), 1.6)
+            if (self._cycle_failures >= 3 and
+                    now - self._cycle_fail_since >= self.POISON_GRACE_S):
+                # The coordinator is gone (rank 0 exited/crashed), and has
+                # been for a real time window — not just a transient pause:
+                # fail pending work with a clear error instead of hanging,
+                # try to tell the control plane so peers are released
+                # rather than left blocked in matching collectives, and
+                # poison this coordinator — continuing to negotiate after
                 # dropping state would diverge from the peers anyway.
                 self._fail_pending_negotiated(ShutdownError(
                     f"negotiation control plane unreachable: {exc}"))
@@ -536,6 +556,8 @@ class EagerCoordinator:
             return
         self._unannounced = []
         self._cycle_failures = 0
+        self._cycle_fail_since = None
+        self._cycle_backoff_until = 0.0
         executed_bytes = self._apply_cycle_response(resp)
         if self.autotuner is not None and executed_bytes > 0:
             if self.autotuner.record_cycle(executed_bytes,
@@ -567,6 +589,24 @@ class EagerCoordinator:
         """Apply coordinator responses strictly in seq order; returns the
         payload bytes executed (the autotuner's numerator)."""
         executed_bytes = 0
+        if getattr(resp, "stale_ack", False):
+            # this rank fell behind the coordinator's bounded response
+            # log (negotiation.py MAX_RESPONSE_LOG): the missed responses
+            # are unrecoverable, so pending work must fail, not hang —
+            # and the peers must hear shutdown, or their matching
+            # collectives (and never-completing table rows) hang forever
+            self._fail_pending_negotiated(ShutdownError(
+                "negotiation response log overflow: this rank fell "
+                "behind the coordinator's retained window"))
+            self._negotiation_dead = True
+            try:
+                self._cycle_req_id += 1
+                self._negotiator.cycle([], self._applied_seq,
+                                       shutdown=True,
+                                       req_id=self._cycle_req_id)
+            except Exception:  # noqa: BLE001 — plane gone too
+                pass
+            return 0
         for off, r in enumerate(resp.responses):
             seq = resp.base_seq + off
             if seq <= self._applied_seq:
@@ -1084,6 +1124,32 @@ class EagerCoordinator:
 
     def shutdown(self):
         self._shutdown = True
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
+        if self._negotiator is not None and not self._negotiation_dead:
+            # Final drain + shutdown announcement in one cycle: apply any
+            # responses the coordinator ALREADY ordered (the peers will
+            # execute those collectives — skipping them here would strand
+            # peers one-sided in the data plane), then the shutdown flag
+            # makes the coordinator ERROR anything that becomes ready
+            # later, so peers' outstanding work fails instead of hanging
+            # (the reference drains outstanding responses before finalize,
+            # operations.cc:1101-1122; RequestList.shutdown →
+            # ResponseList.shutdown, operations.cc:1442-1478).
+            try:
+                self._cycle_req_id += 1
+                resp = self._negotiator.cycle([], self._applied_seq,
+                                              shutdown=True,
+                                              req_id=self._cycle_req_id)
+                if not self._thread.is_alive():
+                    # applying responses mutates _applied_seq/_pending and
+                    # runs device collectives — single-origin territory.
+                    # If the background thread survived the join (stuck
+                    # mid-cycle), announcing shutdown above is all that is
+                    # safe to do from this thread.
+                    self._apply_cycle_response(resp)
+            except Exception:  # noqa: BLE001 — peer may already be gone
+                pass
         with self._queue_lock:
             pending = list(self._tensor_table.values())
             self._tensor_table.clear()
@@ -1093,17 +1159,7 @@ class EagerCoordinator:
         for e in pending:
             e.status = exc
             e.event.set()
-        if self._thread.is_alive():
-            self._thread.join(timeout=2)
         if self._negotiator is not None:
-            # announce shutdown so peers' pending collectives fail with
-            # SHUT_DOWN_ERROR instead of hanging (RequestList.shutdown →
-            # ResponseList.shutdown, operations.cc:1442-1445,1478)
-            try:
-                self._negotiator.cycle([], self._applied_seq,
-                                       shutdown=True)
-            except Exception:  # noqa: BLE001 — peer may already be gone
-                pass
             self._negotiator.close()
             self._negotiator = None
         if self.timeline:

@@ -104,11 +104,16 @@ class NegotiatedResponse:
 
 
 class CycleResponse:
-    def __init__(self, base_seq, responses, params, shutdown):
+    def __init__(self, base_seq, responses, params, shutdown,
+                 stale_ack=False):
         self.base_seq = base_seq      # seq of responses[0]
         self.responses = responses    # list[NegotiatedResponse]
         self.params = params          # (fusion_threshold, cycle_time_ms)
         self.shutdown = shutdown
+        # the requester's ack predates the bounded response log: it can
+        # never catch up and must fail its pending work (see
+        # _prune_acknowledged's cap)
+        self.stale_ack = stale_ack
 
 
 class _TableRow:
@@ -166,35 +171,57 @@ class CoordinatorService(network.BasicService):
             return network.PingResponse(SERVICE_NAME, client_address[0])
         if isinstance(req, CycleRequest):
             with self._lock:
-                if req.shutdown:
-                    self._shutdown = True
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
                 if self._seen_req.get(req.rank) != req.req_id:
                     self._seen_req[req.rank] = req.req_id
                     self._submit(req.rank, req.entries)
                 self._negotiate()
+                # the shutdown flag is set AFTER this request's negotiate:
+                # work that became ready in the departing rank's final
+                # (drain) cycle is still EXECUTE-ordered and rides this
+                # very response, so the drain applies it; anything ready
+                # LATER becomes an ERROR (see _negotiate)
+                if req.shutdown:
+                    self._shutdown = True
                 self._stall_scan()
                 self._prune_acknowledged()
+                stale = req.ack + 1 < self._base_seq
                 start = max(0, req.ack + 1 - self._base_seq)
                 return CycleResponse(
                     self._base_seq + start, list(self._responses[start:]),
                     (self._config.fusion_threshold,
                      self._config.cycle_time_ms),
-                    self._shutdown)
+                    self._shutdown, stale_ack=stale)
         raise NotImplementedError(req)
+
+    # retained-response cap: a rank that crashed (or never reaches the
+    # eager API) must not let the log grow unboundedly for the rest of a
+    # long run. A rank whose ack falls behind the retained window gets
+    # stale_ack=True and fails its pending work instead of hanging.
+    MAX_RESPONSE_LOG = 4096
 
     def _prune_acknowledged(self):
         """Drop response prefixes every rank has applied (each rank's ack
         rides its CycleRequest), bounding coordinator memory over long
-        runs."""
-        if len(self._acks) < self._nproc or not self._responses:
-            return
-        min_ack = min(self._acks.values())
-        drop = min_ack + 1 - self._base_seq
-        if drop > 0:
-            del self._responses[:drop]
-            self._base_seq += drop
+        runs; a hard cap covers ranks that stopped acking entirely."""
+        if len(self._acks) >= self._nproc and self._responses:
+            min_ack = min(self._acks.values())
+            drop = min_ack + 1 - self._base_seq
+            if drop > 0:
+                del self._responses[:drop]
+                self._base_seq += drop
+        over = len(self._responses) - self.MAX_RESPONSE_LOG
+        if over > 0:
+            laggards = sorted(r for r, a in self._acks.items()
+                              if a + 1 < self._base_seq + over)
+            log.warning(
+                "negotiation response log exceeded %d entries; dropping "
+                "%d oldest (ranks %s have fallen behind the retained "
+                "window and will fail their pending work)",
+                self.MAX_RESPONSE_LOG, over, laggards)
+            del self._responses[:over]
+            self._base_seq += over
 
     def _submit(self, rank, entries):
         for meta in entries:
@@ -214,6 +241,20 @@ class CoordinatorService(network.BasicService):
             if row is not None and len(row.metas) == self._nproc:
                 ready.append(name)
         if not ready:
+            return
+        if self._shutdown:
+            # a rank has left: an EXECUTE now would strand the remaining
+            # ranks inside a collective the departed rank never runs
+            # (reference drains, then errors late arrivals —
+            # operations.cc:1101-1122). Fail the work instead.
+            for name in ready:
+                row = self._table.pop(name)
+                self._order.remove(name)
+                op = next(iter(row.metas.values())).op
+                self._responses.append(NegotiatedResponse(
+                    NegotiatedResponse.ERROR, op, [name],
+                    error=f"Horovod has been shut down: {op} '{name}' "
+                          "became ready after a rank requested shutdown."))
             return
         checked = []
         for name in ready:

@@ -286,3 +286,104 @@ class TestNegotiatedFailure:
 
         results = run(fn, num_proc=2, env=_ENV)
         assert results[0] == "shutdown" and results[1] == "exited", results
+
+
+class TestShutdownDrain:
+    """Teardown must not strand peers inside the data plane (reference
+    drains outstanding responses before finalize, operations.cc:1101-1122):
+    already-ordered EXECUTE work is applied by the departing rank's final
+    drain cycle; work becoming ready after shutdown turns into ERROR."""
+
+    def test_coordinator_errors_newly_ready_after_shutdown(self):
+        from horovod_tpu.common.config import HorovodConfig
+        from horovod_tpu.ops import negotiation as neg
+        from horovod_tpu.ops.negotiation import CycleRequest
+        cfg = HorovodConfig(stall_warning_time_seconds=0)
+        svc = neg.CoordinatorService(2, b"k" * 32, ports=[0], config=cfg)
+        try:
+            m = neg.EntryMeta("pre", "allreduce", "float32", (4,), 0, False)
+            # both ranks submit "pre"; rank 1's final request also asks
+            # for shutdown — "pre" became ready IN that request, so it is
+            # still EXECUTE (the drain applies it)
+            svc._handle(CycleRequest(0, [m], ack=-1, req_id=1),
+                        ("127.0.0.1", 0))
+            resp = svc._handle(CycleRequest(1, [m], ack=-1, shutdown=True,
+                                            req_id=1), ("127.0.0.1", 0))
+            assert resp.shutdown
+            assert [r.kind for r in resp.responses] == ["execute"]
+            # work completing AFTER the shutdown flag becomes an ERROR —
+            # an EXECUTE would strand the remaining rank
+            m2 = neg.EntryMeta("post", "allreduce", "float32", (4,), 0,
+                               False)
+            svc._handle(CycleRequest(0, [m2], ack=0, req_id=2),
+                        ("127.0.0.1", 0))
+            resp = svc._handle(CycleRequest(1, [m2], ack=0, req_id=2),
+                               ("127.0.0.1", 0))
+            (err,) = resp.responses
+            assert err.kind == err.ERROR and "shut down" in err.error
+        finally:
+            svc.shutdown()
+
+    def test_response_log_hard_cap_marks_laggards_stale(self):
+        from horovod_tpu.common.config import HorovodConfig
+        from horovod_tpu.ops import negotiation as neg
+        from horovod_tpu.ops.negotiation import CycleRequest
+        cfg = HorovodConfig(fusion_threshold=0,
+                            stall_warning_time_seconds=0)
+        svc = neg.CoordinatorService(2, b"k" * 32, ports=[0], config=cfg)
+        svc.MAX_RESPONSE_LOG = 4  # shrink the cap for the test
+        try:
+            # rank 1 acks nothing (crashed); rank 0 keeps submitting is
+            # not enough — entries need BOTH ranks, so submit from both
+            # but only advance rank 0's ack
+            for i in range(8):
+                m = neg.EntryMeta(f"t{i}", "allreduce", "float32", (4,),
+                                  0, False)
+                svc._handle(CycleRequest(0, [m], ack=i - 1, req_id=10 + i),
+                            ("127.0.0.1", 0))
+                svc._handle(CycleRequest(1, [m], ack=-1, req_id=10 + i),
+                            ("127.0.0.1", 0))
+            assert len(svc._responses) <= 4  # bounded despite no min-ack
+            # the laggard's next request predates the retained window
+            resp = svc._handle(CycleRequest(1, [], ack=-1, req_id=99),
+                               ("127.0.0.1", 0))
+            assert resp.stale_ack
+            # the up-to-date rank is unaffected
+            resp = svc._handle(CycleRequest(0, [], ack=7, req_id=100),
+                               ("127.0.0.1", 0))
+            assert not resp.stale_ack
+        finally:
+            svc.shutdown()
+
+    def test_departing_rank_drains_ordered_collective(self):
+        """Rank 1 pauses its background loop after announcing a tensor,
+        so the EXECUTE response can only be applied by shutdown()'s final
+        drain — rank 0, already blocked inside the device collective,
+        must complete instead of hanging (the pre-fix behavior)."""
+        def fn():
+            import os
+            import time
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.common import state
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            coord = state.global_state().coordinator
+            if r == 1:
+                h = hvd.allreduce_async(np.full((2,), 2.0, np.float32),
+                                        average=False, name="drained")
+                time.sleep(0.5)          # announcement cycle runs
+                coord._paused = True     # loop can no longer apply it
+                time.sleep(1.0)          # rank 0 blocks in the collective
+                hvd.shutdown()           # drain applies the EXECUTE
+                return "shutdown-drained"
+            time.sleep(0.8)
+            h = hvd.allreduce_async(np.full((2,), 1.0, np.float32),
+                                    average=False, name="drained")
+            out = float(np.asarray(hvd.synchronize(h))[0])
+            hvd.shutdown()
+            return out
+
+        results = run(fn, num_proc=2, env=_ENV, start_timeout_s=120.0)
+        assert results[1] == "shutdown-drained"
+        assert results[0] == 3.0, results
