@@ -22,7 +22,7 @@ import itertools
 import jax
 
 from .common import state as state_mod
-from .common.exceptions import HorovodError, NotInitializedError
+from .common.exceptions import NotInitializedError
 from .ops import collective_ops as cops
 from .ops import eager as eager_mod
 from .ops.compression import Compression
@@ -80,31 +80,32 @@ def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
         if num_processes is None:
             # hvdrun's env first, then mpirun/srun's (reference jobs read
             # OMPI_COMM_WORLD_* / PMI_*, test/common.py:25-57) — so
-            # `mpirun -np N python train.py` works with only
+            # `mpirun -np N` / `srun -nN python train.py` works with only
             # HVD_COORDINATOR_ADDR exported
             num_processes = _env_first("HVD_NUM_PROC",
                                        "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                                       "SLURM_STEP_NUM_TASKS",
                                        default=1)
         if process_id is None:
             process_id = _env_first("HVD_PROCESS_ID",
                                     "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                                    "SLURM_PROCID",
                                     default=0)
     elif coordinator_address is None and num_processes is None:
         # mpirun/srun compatibility: reference jobs launch under MPI and
-        # read OMPI_COMM_WORLD_* / PMI_* (test/common.py:25-57). Honor the
-        # same ranks here so `mpirun -np N python train.py` migrates —
-        # the rendezvous address still must come from HVD_COORDINATOR_ADDR
-        # (MPI exports no equivalent; hvdrun/run(fn)/spark set it), unless
+        # read OMPI_COMM_WORLD_* / PMI_* (test/common.py:25-57). MPI
+        # exports no rendezvous address, so derive one automatically:
+        # rank 0 publishes host:port through the filesystem keyed by the
+        # job id (run/mpi.py) — `mpirun -np N python train.py` works with
+        # zero extra env on one host or a shared-FS cluster (reference
+        # parity: run/run.py:458-481 jobs need nothing extra). Skipped if
         # the caller bootstrapped jax.distributed itself (TPU pods).
-        mpi_size = os.environ.get("OMPI_COMM_WORLD_SIZE",
-                                  os.environ.get("PMI_SIZE"))
-        if mpi_size is not None and int(mpi_size) > 1 and \
+        from .run import mpi as mpi_compat
+        world = mpi_compat.detect_mpi_world()
+        if world is not None and world[0] > 1 and \
                 not _jax_distributed_live():
-            raise HorovodError(
-                "MPI launch detected (world size "
-                f"{mpi_size}) but no rendezvous address: export "
-                "HVD_COORDINATOR_ADDR=host:port of rank 0 (mpirun does "
-                "not provide one), or launch with hvdrun")
+            coordinator_address, num_processes, process_id = \
+                mpi_compat.auto_rendezvous(*world)
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

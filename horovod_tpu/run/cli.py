@@ -29,10 +29,7 @@ from . import exec_util, hosts, secret, services, task_fn
 from .settings import Settings, Timeout
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+from .network import free_port as _free_port  # shared socket idiom
 
 
 def parse_args(argv=None):

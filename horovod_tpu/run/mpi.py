@@ -1,0 +1,162 @@
+"""mpirun/srun migration compatibility: automatic rendezvous derivation.
+
+The reference runs under mpirun with no extra configuration — MPI itself
+is the rendezvous (reference run/run.py:458-481 just execs the job).
+Here the data plane needs a ``jax.distributed`` coordinator address, and
+MPI exports no equivalent, so ``mpirun -np N python train.py`` would
+previously require ``HVD_COORDINATOR_ADDR``. This module removes that
+papercut: rank 0 picks a reachable address + free port and publishes it
+through the filesystem (atomic write + rename), keyed by a per-job
+identifier from the MPI environment; other ranks poll for it.
+
+Works with zero extra env on a single host, and on multi-host clusters
+with a shared filesystem (the usual HPC layout). Multi-host without a
+shared FS still needs ``HVD_COORDINATOR_ADDR`` — there is no channel at
+all in that case. The publish directory is overridable with
+``HVD_RENDEZVOUS_DIR`` (point it at the shared FS if tmp is host-local).
+"""
+
+import atexit
+import hashlib
+import json
+import os
+import socket
+import tempfile
+import time
+
+from ..common import hvd_logging as log
+
+# env pairs: (size, rank) for the launchers the reference supports
+# (reference test/common.py:25-57 reads the same ones). For SLURM the
+# STEP task count is what matters: `sbatch --ntasks=4` exports
+# SLURM_NTASKS=4 into the batch step even when the script runs one plain
+# `python train.py` (no srun) — keying on SLURM_NTASKS would make that
+# lone process wait forever for 3 peers that were never launched.
+# srun -nN sets SLURM_STEP_NUM_TASKS=N for the actual step.
+_MPI_ENVS = (
+    ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
+    ("PMI_SIZE", "PMI_RANK"),
+    ("SLURM_STEP_NUM_TASKS", "SLURM_PROCID"),
+)
+
+# per-job-unique values mpirun/srun export to EVERY rank; the first one
+# present keys the rendezvous file so concurrent jobs cannot collide
+_JOB_ID_ENVS = (
+    "OMPI_MCA_orte_hnp_uri",   # OpenMPI ≤4: hnp jobid + contact address
+    "PMIX_NAMESPACE",          # OpenMPI 5 / prrte
+    "PMI_JOBID",
+    "SLURM_JOB_ID",
+)
+
+
+def detect_mpi_world():
+    """(size, rank) from the MPI/slurm env, or None when not launched by
+    an MPI-style launcher."""
+    for size_env, rank_env in _MPI_ENVS:
+        if size_env in os.environ:
+            return (int(os.environ[size_env]),
+                    int(os.environ.get(rank_env, 0)))
+    return None
+
+
+def _job_key():
+    for env in _JOB_ID_ENVS:
+        val = os.environ.get(env)
+        if val:
+            return hashlib.sha256(
+                f"{env}={val}".encode()).hexdigest()[:16], True
+    # no per-job identifier: fall back to (user, cwd) — unique enough for
+    # one job at a time, but concurrent jobs from the same directory
+    # would collide, so warn
+    fallback = f"uid{os.getuid()}:{os.getcwd()}"
+    return hashlib.sha256(fallback.encode()).hexdigest()[:16], False
+
+
+def _rendezvous_path(key):
+    base = os.environ.get("HVD_RENDEZVOUS_DIR", tempfile.gettempdir())
+    return os.path.join(base, f"hvd_mpi_rdzv_{key}.json")
+
+
+def auto_rendezvous(size, rank, timeout_s=60.0):
+    """Derive (coordinator_address, num_processes, process_id) under an
+    MPI launch with no HVD_COORDINATOR_ADDR: rank 0 binds a free port on
+    its advertised IP and publishes host:port via the filesystem; other
+    ranks poll until it appears."""
+    from . import network
+
+    key, unique = _job_key()
+    if not unique:
+        log.warning(
+            "mpirun launch with no per-job identifier in the environment "
+            "(%s): deriving the rendezvous from (uid, cwd) — concurrent "
+            "jobs from this directory would collide; export "
+            "HVD_COORDINATOR_ADDR to pin it explicitly",
+            "/".join(_JOB_ID_ENVS))
+    path = _rendezvous_path(key)
+    if rank == 0:
+        ip = network.advertise_ip()
+        port = network.free_port()
+        record = {"addr": f"{ip}:{port}", "size": size,
+                  "created": time.time()}
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, path)  # atomic: readers never see a partial
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        atexit.register(_cleanup, path)
+        log.info("mpirun rendezvous: rank 0 published %s at %s",
+                 record["addr"], path)
+        return record["addr"], size, 0
+    start = time.time()
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with open(path) as f:
+                record = json.load(f)
+            # reject leftovers of a crashed previous run with the same
+            # key: this job's rank 0 writes at roughly the same wall
+            # time the workers start polling (120s covers NFS skew) —
+            # AND the coordinator must actually be listening. A dead
+            # run's file (SIGKILL skips the atexit cleanup) would
+            # otherwise send this rank into jax.distributed.initialize
+            # against a port nothing serves, hanging with no error.
+            # Rank 0 of the fresh run binds its coordinator right after
+            # publishing, so a failed probe just means "keep polling".
+            if (record.get("size") == size and
+                    record.get("created", 0) >= start - 120.0 and
+                    _coordinator_listening(record["addr"])):
+                return record["addr"], size, rank
+        except (OSError, ValueError):
+            pass
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"mpirun rendezvous: rank {rank} found no published "
+                f"coordinator address at {path} within {timeout_s}s — "
+                "multi-host without a shared filesystem? Export "
+                "HVD_COORDINATOR_ADDR=host:port of rank 0, or set "
+                "HVD_RENDEZVOUS_DIR to a shared directory")
+        time.sleep(0.1)
+
+
+def _coordinator_listening(addr):
+    """True if something accepts TCP connections at host:port. The jax
+    coordinator is gRPC — a connect-and-close probe is harmless."""
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)), timeout=1.0):
+            return True
+    except OSError:
+        return False
+
+
+def _cleanup(path):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

@@ -73,6 +73,39 @@ def local_addresses(port=None):
     return result
 
 
+def free_port():
+    """An OS-assigned free TCP port (bind 0, read, release). The usual
+    caveat applies: the port is only reserved while bound, so callers
+    should bind their real socket promptly."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def advertise_ip():
+    """The IP this host should publish for peers to connect to: the
+    default-route interface first (a UDP connect selects it without
+    sending traffic — on multi-NIC hosts the first enumerated NIC is
+    often a docker bridge or overlay peers cannot reach), then the first
+    non-loopback NIC, then gethostname (which /etc/hosts commonly maps to
+    127.0.x.1 — last resort only). The reference's full solution is
+    cross-host NIC intersection (run/run.py:188-257), which needs a
+    control plane that does not exist yet when this runs."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 53))  # no packet is sent for UDP
+            ip = s.getsockname()[0]
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    for addrs in local_addresses().values():
+        for ip, _ in addrs:
+            if not ip.startswith("127."):
+                return ip
+    return socket.gethostbyname(socket.gethostname())
+
+
 class BasicService:
     """Threaded TCP server speaking Wire; subclasses override _handle."""
 

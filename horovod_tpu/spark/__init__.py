@@ -34,45 +34,19 @@ except ImportError as _e:  # pragma: no cover - exercised only w/o pyspark
 from ..run import network, secret
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+_free_port = network.free_port
 
 
 def _host_ip():
     """A reachable IP of this executor to publish as the rendezvous
-    address. Resolution order:
-
-    1. ``HVD_SPARK_BIND_ADDR`` — operator override for topologies no
-       heuristic can see through.
-    2. The interface of the default route (a UDP connect to a public
-       address selects it without sending traffic) — on multi-NIC
-       executors (docker bridges, VPN/overlay interfaces) the first
-       enumerated NIC is often one peers cannot reach; the default-route
-       interface is the one with cluster connectivity. The reference
-       solves this with cross-host NIC intersection (run/run.py:188-257),
-       which needs a control plane that does not exist yet at this point
-       in the Spark bootstrap.
-    3. First non-loopback NIC (run/network.py local_addresses), then
-       gethostname — which commonly resolves to 127.0.x.1 via /etc/hosts.
-    """
+    address: the ``HVD_SPARK_BIND_ADDR`` operator override (for
+    topologies no heuristic can see through), else the default-route /
+    first-NIC heuristic shared with the other launchers
+    (run/network.py advertise_ip)."""
     pinned = os.environ.get("HVD_SPARK_BIND_ADDR")
     if pinned:
         return pinned
-    try:
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            s.connect(("8.8.8.8", 53))  # no packet is sent for UDP
-            ip = s.getsockname()[0]
-        if not ip.startswith("127."):
-            return ip
-    except OSError:
-        pass
-    for addrs in network.local_addresses().values():
-        for ip, _ in addrs:
-            if not ip.startswith("127."):
-                return ip
-    return socket.gethostbyname(socket.gethostname())
+    return network.advertise_ip()
 
 
 def worker_env(rank, num_proc, coordinator_addr, key_b64, extra_env=None):

@@ -46,18 +46,27 @@ def test_rank_inside_shard_map(hvd):
 
 
 class TestMpirunCompat:
-    def test_mpi_env_without_rendezvous_raises_helpfully(self, monkeypatch):
+    def test_mpi_env_without_rendezvous_derives_one(self, monkeypatch):
         """mpirun-launched jobs (reference OMPI_COMM_WORLD_* env,
-        test/common.py:25-57) get a clear pointer to
-        HVD_COORDINATOR_ADDR instead of silently initializing
-        single-process."""
+        test/common.py:25-57) no longer need HVD_COORDINATOR_ADDR:
+        init() routes through the automatic filesystem rendezvous
+        (run/mpi.py) with the detected world. End-to-end coverage:
+        tests/test_mpi_compat.py."""
         import horovod_tpu as hvd_mod
+        from horovod_tpu.run import mpi as mpi_compat
         monkeypatch.delenv("HVD_COORDINATOR_ADDR", raising=False)
         monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
         monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
-        with pytest.raises(hvd_mod.HorovodError,
-                           match="HVD_COORDINATOR_ADDR"):
+        seen = {}
+
+        def fake_rendezvous(size, rank, timeout_s=60.0):
+            seen.update(size=size, rank=rank)
+            raise RuntimeError("stop before jax.distributed")
+
+        monkeypatch.setattr(mpi_compat, "auto_rendezvous", fake_rendezvous)
+        with pytest.raises(RuntimeError, match="stop before"):
             hvd_mod.init()
+        assert seen == {"size": 4, "rank": 1}
 
     def test_mpi_ranks_honored_with_rendezvous(self, monkeypatch):
         """With the rendezvous exported, OMPI ranks feed
