@@ -21,6 +21,7 @@ import itertools
 
 import jax
 
+from .common import hvd_logging as log
 from .common import state as state_mod
 from .common.exceptions import NotInitializedError
 from .ops import collective_ops as cops
@@ -28,6 +29,11 @@ from .ops import eager as eager_mod
 from .ops.compression import Compression
 
 _name_counter = itertools.count()
+
+# True iff THIS module called jax.distributed.initialize (shutdown()
+# then tears it down, so reused worker processes — Spark keeps Python
+# workers alive across jobs — can init() again with a fresh coordinator)
+_initialized_jax_distributed = False
 
 # re-exported identity API (reference common/basics.py)
 size = state_mod.size
@@ -107,9 +113,22 @@ def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
             coordinator_address, num_processes, process_id = \
                 mpi_compat.auto_rendezvous(*world)
     if coordinator_address is not None or num_processes is not None:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+        if _jax_distributed_live():
+            # a previous runtime is still up (caller-bootstrapped, or a
+            # reused worker process — e.g. Spark reuses Python workers
+            # across jobs); initialize() would raise "should only be
+            # called once". shutdown() tears ours down (see below), so
+            # reaching here live means the caller owns the runtime.
+            log.warning(
+                "jax.distributed already initialized; keeping the live "
+                "runtime instead of re-initializing with %s",
+                coordinator_address)
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+            global _initialized_jax_distributed
+            _initialized_jax_distributed = True
     state = state_mod.init_state(devices=devices, mesh=mesh,
                                  axis_name=axis_name, config=config)
     state.coordinator = eager_mod.EagerCoordinator(state)
@@ -119,10 +138,19 @@ def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
 
 def shutdown():
     """Shut down (reference horovod_shutdown, operations.cc:1101-1122)."""
+    global _initialized_jax_distributed
     state = state_mod.global_state()
     if state.coordinator is not None:
         state.coordinator.shutdown()
     state_mod.shutdown_state()
+    if _initialized_jax_distributed:
+        # only tear down a runtime WE brought up — a caller-bootstrapped
+        # jax.distributed (TPU pods) outlives hvd.shutdown()
+        _initialized_jax_distributed = False
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # noqa: BLE001 — already gone is fine
+            log.debug("jax.distributed.shutdown: %s", e)
 
 
 def mpi_threads_supported():

@@ -1,0 +1,60 @@
+"""MXNet frontend against the REAL mxnet package.
+
+The default CI image has no mxnet, so the main suite exercises the
+frontend against a numpy-backed NDArray stand-in
+(tests/test_mxnet_frontend.py — registered as ``mxnet`` in sys.modules).
+These tests close the gap the stand-in leaves (reference CI runs real
+mxnet: docker-compose.test.yml): run them in an environment with mxnet
+installed via ``pytest tests/integration -m integration``.
+
+They skip (not pass) when mxnet is absent or when the stand-in is
+already registered, so CI honestly reports what was verified where
+(PARITY.md documents the same).
+"""
+
+import numpy as np
+import pytest
+
+mx = pytest.importorskip("mxnet")
+if getattr(mx, "__file__", None) is None:
+    pytest.skip("the numpy stand-in is registered as mxnet, not the "
+                "real package", allow_module_level=True)
+
+pytestmark = pytest.mark.integration
+
+
+@pytest.fixture
+def hvd():
+    import horovod_tpu.mxnet as hvd_mod
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+class TestRealMxnet:
+    def test_allreduce_ndarray(self, hvd):
+        x = mx.nd.array([1.0, 2.0, 3.0])
+        out = hvd.allreduce(x, average=True)
+        np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0, 3.0])
+
+    def test_allreduce_inplace(self, hvd):
+        x = mx.nd.array([[2.0, 4.0]])
+        hvd.allreduce_(x, average=False)
+        np.testing.assert_allclose(x.asnumpy(), [[2.0, 4.0]])
+
+    def test_broadcast_parameters(self, hvd):
+        params = {"w": mx.nd.ones((2, 2)) * 7}
+        hvd.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(params["w"].asnumpy(),
+                                   np.full((2, 2), 7.0))
+
+    def test_distributed_trainer_step(self, hvd):
+        from mxnet import gluon
+        net = gluon.nn.Dense(1, in_units=2)
+        net.initialize()
+        trainer = hvd.DistributedTrainer(net.collect_params(), "sgd",
+                                         {"learning_rate": 0.1})
+        with mx.autograd.record():
+            loss = (net(mx.nd.ones((4, 2))) ** 2).mean()
+        loss.backward()
+        trainer.step(4)  # must not raise; grads rode the eager core
