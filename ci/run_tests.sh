@@ -21,5 +21,18 @@ env -u XLA_FLAGS -u JAX_PLATFORMS \
 echo "--- example smoke tests"
 make examples
 
+echo "--- scaling-efficiency gate (north star: BASELINE.json >=90% @ v5e-64)"
+# The sweep must complete AND produce a sane efficiency fraction on the
+# 8-device CPU mesh; the same harness runs unchanged on real chips.
+# Virtual CPU devices share host cores, so ~0.5 is the CEILING at
+# 1->2 workers (measured 0.42-0.50 healthy) — the gate catches a broken
+# sweep or missing metric, not a perf regression (ci/check_scaling.py).
+SCALING_LINE=$(env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/scaling_benchmark.py --model resnet18 --batch-size 2 \
+        --image-size 32 --device-counts 1,2 --num-warmup-batches 1 \
+        --num-iters 2 --num-batches-per-iter 2 | tail -1)
+python ci/check_scaling.py "$SCALING_LINE"
+
 echo "--- benchmark smoke"
 python bench.py
