@@ -80,7 +80,10 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
     d = q_ref.shape[-1]
     # matmul operands stay in the input dtype (bf16 runs the MXU at full
     # rate; fp32 would quarter it on v5e) — accumulation is fp32 via
-    # preferred_element_type, softmax statistics are fp32 throughout
+    # preferred_element_type, softmax statistics are fp32 throughout.
+    # (Measured dead ends on v5e: folding the softmax scale into q, and
+    # lax.cond-skipping the causal mask on fully-visible tiles — both
+    # slower than this straight-line form; Mosaic pipelines it best.)
     q = q_ref[0]                                # [block_q, d]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
