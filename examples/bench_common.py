@@ -96,29 +96,21 @@ def transformer_matmul_flops_per_token(cfg, seq):
     return 6 * p_matmul + 12 * cfg.num_layers * seq * cfg.d_model
 
 
-def bench_transformer_lm(on_tpu, peak_flops=None):
-    """Timed flagship-transformer training window (the canonical source
-    of the tokens/sec/chip + MFU numbers in bench.py's JSON line and
-    docs/benchmarks.md — keep single-sourced so harnesses cannot drift).
-    Returns a metrics dict."""
+def build_transformer_step(mesh, batch, seq, cfg=None, on_tpu=True):
+    """Compiled GSPMD train step + initial state for the flagship
+    transformer LM (shared by bench.py's MFU line and
+    scaling_benchmark --model transformer, so the recipes cannot
+    drift). Returns (step, params, opt_state, tokens, cfg)."""
     import numpy as np
     import optax
 
     from horovod_tpu.models import transformer as tr
-    from horovod_tpu.parallel import mesh as mesh_mod
 
-    if on_tpu:
-        cfg = tr.TransformerConfig.gpt2_small(attention_impl="flash")
-        batch_per_chip, seq, steps = 8, 1024, 20
-    else:  # CI smoke on CPU: tiny everything, no MFU claim
-        cfg = tr.TransformerConfig.tiny(attention_impl="full")
-        batch_per_chip, seq, steps = 2, 64, 3
-
-    n = hvd.size()
-    mesh = mesh_mod.build_mesh(dp=n)
+    if cfg is None:
+        cfg = (tr.TransformerConfig.gpt2_small(attention_impl="flash")
+               if on_tpu else
+               tr.TransformerConfig.tiny(attention_impl="full"))
     model = tr.TransformerLM(cfg)
-    rng = np.random.RandomState(0)
-    batch = batch_per_chip * n
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((2, seq), jnp.int32))["params"]
     tx = optax.adamw(3e-4)
@@ -128,9 +120,30 @@ def bench_transformer_lm(on_tpu, peak_flops=None):
     params = jax.tree_util.tree_map(jax.device_put, params, pshard)
     opt_state = trainer.init_opt_state(tx, params, mesh,
                                        tr.param_specs(params))
+    rng = np.random.RandomState(0)
     toks = jax.device_put(
         jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq),
                                 dtype=np.int64).astype(np.int32)), bshard)
+    return step, params, opt_state, toks, cfg
+
+
+def bench_transformer_lm(on_tpu, peak_flops=None):
+    """Timed flagship-transformer training window (the canonical source
+    of the tokens/sec/chip + MFU numbers in bench.py's JSON line and
+    docs/benchmarks.md — keep single-sourced so harnesses cannot drift).
+    Returns a metrics dict."""
+    from horovod_tpu.parallel import mesh as mesh_mod
+
+    if on_tpu:
+        batch_per_chip, seq, steps = 8, 1024, 20
+    else:  # CI smoke on CPU: tiny everything, no MFU claim
+        batch_per_chip, seq, steps = 2, 64, 3
+
+    n = hvd.size()
+    mesh = mesh_mod.build_mesh(dp=n)
+    batch = batch_per_chip * n
+    step, params, opt_state, toks, cfg = build_transformer_step(
+        mesh, batch, seq, on_tpu=on_tpu)
     params, opt_state, loss = step(params, opt_state, toks)
     float(loss)  # scalar read = true barrier on remote-attached runtimes
     t0 = time.perf_counter()

@@ -32,7 +32,8 @@ from bench_common import build_step, positive_int, timed_rates
 
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="resnet50", choices=models.names())
+    p.add_argument("--model", default="resnet50",
+                   choices=sorted(models.names()) + ["transformer"])
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-worker batch (fixed across the sweep)")
     p.add_argument("--device-counts", default=None,
@@ -47,12 +48,31 @@ def parse_args():
 
 
 def measure(args, n_devices):
-    """img/sec per worker on the first n_devices local devices."""
+    """samples/sec per worker (images, or sequences for the flagship
+    transformer) on the first n_devices local devices."""
+    from bench_common import build_transformer_step
+
     hvd.init(devices=jax.devices()[:n_devices])
     batch = args.batch_size * n_devices
-    step, params, opt_state, batch_data = build_step(
-        args.model, hvd.mesh(), batch, args.image_size,
-        fp16_allreduce=args.fp16_allreduce)
+    if args.model == "transformer":
+        from horovod_tpu.parallel import mesh as mesh_mod
+        if args.fp16_allreduce or args.image_size is not None:
+            raise SystemExit(
+                "--fp16-allreduce/--image-size apply to the image zoo "
+                "only; the transformer step has its own recipe "
+                "(bench_common.build_transformer_step)")
+        on_tpu = jax.devices()[0].platform == "tpu"
+        seq = 1024 if on_tpu else 64
+        # the transformer's param specs name dp/tp/sp/ep axes, so it
+        # needs the named mesh, not init()'s default 1-D 'hvd' mesh
+        dp_mesh = mesh_mod.build_mesh(
+            dp=n_devices, devices=jax.devices()[:n_devices])
+        step, params, opt_state, batch_data, _ = build_transformer_step(
+            dp_mesh, batch, seq, on_tpu=on_tpu)
+    else:
+        step, params, opt_state, batch_data = build_step(
+            args.model, hvd.mesh(), batch, args.image_size,
+            fp16_allreduce=args.fp16_allreduce)
     rates = timed_rates(step, params, opt_state, batch_data, batch,
                         args.num_warmup_batches, args.num_iters,
                         args.num_batches_per_iter)
@@ -77,20 +97,23 @@ def main():
         while c <= n_avail:
             counts.append(c)
             c *= 2
-    if args.image_size is None:
+    if args.image_size is None and args.model != "transformer":
         on_tpu = jax.devices()[0].platform == "tpu"
         args.image_size = models.image_size(args.model) if on_tpu else 64
 
     base = counts[0]
+    shape_note = ("seq 1024 (64 on cpu)" if args.model == "transformer"
+                  else f"image {args.image_size}")
     print(f"Model: {args.model}, batch {args.batch_size}/worker, "
-          f"image {args.image_size}, devices {counts} "
+          f"{shape_note}, devices {counts} "
           f"(efficiency baseline: {base} worker(s))")
+    rate_unit = "seq/sec" if args.model == "transformer" else "img/sec"
     results = []
     for n in counts:
         rate = measure(args, n)
         eff = rate / results[0][1] if results else 1.0
         results.append((n, rate, eff))
-        print(f"  {n} worker(s): {rate:.1f} img/sec/worker, "
+        print(f"  {n} worker(s): {rate:.1f} {rate_unit}/worker, "
               f"total {rate * n:.1f}, "
               f"efficiency vs {base}-worker: {eff:.1%}")
 
@@ -100,7 +123,8 @@ def main():
         "value": round(results[-1][2], 4),
         "unit": "fraction",
         "baseline_workers": base,
-        "per_worker_img_sec": {str(n): round(r, 1) for n, r, _ in results},
+        "rate_unit": rate_unit,
+        "per_worker_rate": {str(n): round(r, 1) for n, r, _ in results},
     }))
 
 

@@ -77,6 +77,63 @@ class TestDevicePlane:
         for outs in run(fn, num_proc=2, env=_ENV):
             assert outs == [0.0, 2.0, 4.0, 6.0]
 
+    def test_dtype_coverage_across_processes(self):
+        """The device plane must carry every wire dtype the reference's
+        MPI/NCCL ops dispatch on (mpi_operations.cc): floats down to
+        f16/bf16 and ints — with exact sums at the carried precision.
+        Wide inputs (f64/i64) follow jax's dtype canonicalization: with
+        x64 disabled (the framework default) they are carried as
+        f32/i32, the same rule every other jax value in the program
+        follows — asserted here so the contract is explicit, not
+        accidental."""
+        def fn():
+            import jax.numpy as jnp
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.common import state
+            hvd.init()
+            r = state.process_rank()
+            eng = state.global_state().coordinator._proc_engine
+            out = {}
+            for name, dtype, val in [
+                    ("f64", np.float64, 1.25), ("f16", np.float16, 0.5),
+                    ("i32", np.int32, 3), ("i64", np.int64, 1 << 20)]:
+                x = np.full((4,), val, dtype) * (r + 1)
+                res = eng.allreduce(x)
+                out[name] = (str(res.dtype),
+                             np.asarray(res).tolist())
+            bf = jnp.full((4,), 1.5, jnp.bfloat16) * (r + 1)
+            res = eng.allreduce(bf)
+            out["bf16"] = (str(res.dtype),
+                           np.asarray(res, np.float32).tolist())
+            hvd.shutdown()
+            return out
+
+        for res in run(fn, num_proc=2, env=_ENV):
+            # canonicalized wide dtypes (jax x64 disabled)
+            assert res["f64"] == ("float32", [3.75] * 4)   # 1.25*(1+2)
+            assert res["i64"] == ("int32", [3 << 20] * 4)
+            # narrow dtypes carried as-is
+            assert res["f16"] == ("float16", [1.5] * 4)
+            assert res["i32"] == ("int32", [9] * 4)        # 3*(1+2)
+            assert res["bf16"] == ("bfloat16", [4.5] * 4)
+
+    def test_large_payload_fused(self):
+        """A multi-MB fused buffer survives the device plane intact
+        (exercises real DMA/collective paths, not just tiny shapes)."""
+        def fn():
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            n = 1 << 20  # 4 MB of float32
+            x = np.arange(n, dtype=np.float32)
+            out = np.asarray(hvd.allreduce(x, average=True))
+            ok = bool(np.array_equal(out, x))
+            hvd.shutdown()
+            return ok
+
+        assert run(fn, num_proc=2, env=_ENV) == [True, True]
+
     def test_engine_ops_three_processes(self):
         """Value checks for every engine op at P=3 (odd world size
         exercises non-power-of-two rings)."""
