@@ -142,6 +142,100 @@ def broadcast_variables(variables, root_rank=0):
         v.assign(synchronize(h))
 
 
+def _session_broadcast(variables, root_rank, session, assigns=None,
+                       placeholders=None):
+    """Graph-mode broadcast round-trip: read values via ``session.run``,
+    broadcast through the eager core, assign back through placeholder
+    feeds (the role of the reference's in-graph broadcast op,
+    tensorflow/__init__.py:95-105, which our value-based core cannot
+    build)."""
+    import tensorflow as tf
+    if assigns is None:
+        with session.graph.as_default():
+            placeholders = [tf.compat.v1.placeholder(v.dtype, v.shape)
+                            for v in variables]
+            assigns = [v.assign(p) for v, p in zip(variables,
+                                                   placeholders)]
+    values = session.run(list(variables))
+    handles = [_core.broadcast_async(
+        np.array(v, copy=True), root_rank=root_rank,
+        name=f"bcast_sess.{i}", kind="replicated")
+        for i, v in enumerate(values)]
+    reduced = [np.asarray(_core.synchronize(h)) for h in handles]
+    session.run(assigns, feed_dict=dict(zip(placeholders, reduced)))
+
+
+def broadcast_global_variables(root_rank=0, session=None):
+    """Broadcast all TF1 global variables from root_rank (reference
+    tensorflow/__init__.py:85-93).
+
+    TF2-eager variables never enter the compat.v1 global collection, so
+    an empty collection raises with a pointer to
+    ``broadcast_variables(model.weights)`` instead of silently
+    broadcasting nothing (divergent initial weights are the worst
+    silent failure a data-parallel job can have). In graph mode the
+    values round-trip a session (default: the current default session;
+    inside ``tf.estimator``, use ``BroadcastGlobalVariablesHook``)."""
+    import tensorflow as tf
+    variables = tf.compat.v1.global_variables()
+    if not variables:
+        raise ValueError(
+            "no TF1 global variables are registered — TF2-eager "
+            "variables never enter the compat.v1 collection; use "
+            "broadcast_variables(model.weights) (or the Keras "
+            "BroadcastGlobalVariablesCallback) instead")
+    if tf.executing_eagerly():
+        broadcast_variables(variables, root_rank=root_rank)
+        return
+    session = session or tf.compat.v1.get_default_session()
+    if session is None:
+        raise ValueError(
+            "graph-mode broadcast_global_variables needs a session: "
+            "pass session=..., run under a default session, or use "
+            "BroadcastGlobalVariablesHook")
+    _session_broadcast(variables, root_rank, session)
+
+
+def _make_broadcast_hook():
+    import tensorflow as tf
+
+    class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+        """Session hook broadcasting global variables once after session
+        creation (reference tensorflow/__init__.py:107-139), via the
+        _session_broadcast round-trip. ``device`` is accepted for
+        signature parity and unused: there is no in-graph broadcast op
+        to place — values ride the eager core."""
+
+        def __init__(self, root_rank=0, device=""):
+            super().__init__()
+            self.root_rank = root_rank
+            self._assigns = None
+
+        def begin(self):
+            variables = tf.compat.v1.global_variables()
+            self._variables = variables
+            self._placeholders = [
+                tf.compat.v1.placeholder(v.dtype, v.shape) for v in
+                variables]
+            self._assigns = [v.assign(p) for v, p in
+                             zip(variables, self._placeholders)]
+
+        def after_create_session(self, session, coord):
+            _session_broadcast(self._variables, self.root_rank, session,
+                               assigns=self._assigns,
+                               placeholders=self._placeholders)
+
+    return BroadcastGlobalVariablesHook
+
+
+def __getattr__(name):  # PEP 562: build the TF-typed hook class lazily
+    if name == "BroadcastGlobalVariablesHook":
+        cls = _make_broadcast_hook()
+        globals()[name] = cls
+        return cls
+    raise AttributeError(name)
+
+
 class DistributedGradientTape:
     """tf.GradientTape wrapper whose ``gradient()`` averages the grads
     across workers (reference tensorflow/__init__.py:242-316)."""

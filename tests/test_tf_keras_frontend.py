@@ -251,3 +251,38 @@ class TestGraphFusedAllreduce:
             # v - lr * mean_grad = [2,4] - 1.0*[1.5,1.5]
             np.testing.assert_allclose(vals, [0.5, 2.5])
             assert n_calls == 1, "one fused host collective per step"
+
+
+class TestTf1Compat:
+    def test_broadcast_global_variables_empty_collection_raises(
+            self, tfhvd):
+        """TF2-eager variables never enter the compat.v1 collection:
+        silently broadcasting nothing would leave workers with divergent
+        initial weights, so the empty case must raise with a pointer."""
+        tf.Variable([3.0, 4.0], name="bgv_var")  # NOT in the collection
+        with pytest.raises(ValueError, match="broadcast_variables"):
+            tfhvd.broadcast_global_variables(0)
+
+    def test_broadcast_global_variables_graph_mode(self, tfhvd):
+        g = tf.Graph()
+        with g.as_default():
+            v = tf.compat.v1.get_variable("bgv_graph_var",
+                                          initializer=[7.0, 8.0])
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                tfhvd.broadcast_global_variables(0)  # default session
+                np.testing.assert_allclose(sess.run(v), [7.0, 8.0])
+
+    def test_broadcast_hook_in_session(self, tfhvd):
+        """The TF1 session hook (reference tensorflow/__init__.py:107-139):
+        values round-trip session -> eager core broadcast -> session."""
+        g = tf.Graph()
+        with g.as_default():
+            v = tf.compat.v1.get_variable(
+                "hook_var", initializer=[1.5, 2.5])
+            hook = tfhvd.BroadcastGlobalVariablesHook(0)
+            hook.begin()
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                hook.after_create_session(sess, None)
+                np.testing.assert_allclose(sess.run(v), [1.5, 2.5])
