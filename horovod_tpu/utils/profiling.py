@@ -1,0 +1,137 @@
+"""Device-trace summarization for `jax.profiler` captures.
+
+The timeline (utils/timeline.py) answers "what did the *framework* do";
+this module answers "where did the *device* time go" from a profiler
+trace directory — the analysis loop used to find the round-2 wins
+(tile-misaligned sequence dims, fp32 matmul operands, the flash-kernel
+pipeline flush) without leaving Python:
+
+    with jax.profiler.trace("/tmp/prof"):
+        for _ in range(3):
+            state = step(state, batch)
+        jax.block_until_ready(state)
+    from horovod_tpu.utils.profiling import summarize_trace
+    for row in summarize_trace("/tmp/prof").top(20):
+        print(row)
+
+Works on the `*.trace.json.gz` files XLA writes under
+``<dir>/plugins/profile/<ts>/``; host-side Python spans (``$``-prefixed)
+and jit dispatch wrappers are excluded so the durations are device-op
+time, not wall clock.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+class OpRow:
+    __slots__ = ("name", "group", "total_ms", "count", "long_name")
+
+    def __init__(self, name, group, total_ms, count, long_name):
+        self.name = name
+        self.group = group
+        self.total_ms = total_ms
+        self.count = count
+        self.long_name = long_name
+
+    def __repr__(self):
+        extra = f"  {self.long_name[:80]}" if self.long_name else ""
+        return (f"{self.total_ms:9.3f} ms  x{self.count:<4d} "
+                f"{self.name[:40]:40s}{extra}")
+
+
+class TraceSummary:
+    def __init__(self, rows):
+        self.rows = sorted(rows, key=lambda r: -r.total_ms)
+
+    @property
+    def total_ms(self):
+        return sum(r.total_ms for r in self.rows)
+
+    def top(self, n=20):
+        return self.rows[:n]
+
+    def by_group(self):
+        """Total ms per op family (fusion kinds, custom-call kernels,
+        copies, ...) — the first place to look."""
+        groups = collections.Counter()
+        for r in self.rows:
+            groups[r.group] += r.total_ms
+        return groups.most_common()
+
+
+_EXCLUDE_PREFIXES = ("$", "jit_", "Pjit", "np.", "PythonRefManager",
+                     "ParseArguments", "PjRt", "Thunk")
+
+
+def _is_device_op(name):
+    if not name or name.startswith(_EXCLUDE_PREFIXES):
+        return False
+    if " " in name or name.isdigit():
+        return False  # python stack frames / step-group lanes
+    return True
+
+
+def find_trace_file(path):
+    """``path`` may be the profiler output dir or a trace file itself."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(
+        os.path.join(path, "**", "*.trace.json*"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(
+            f"no *.trace.json(.gz) under {path!r} — pass the directory "
+            "given to jax.profiler.trace(...)")
+    return hits[-1]  # newest capture
+
+
+def summarize_trace(path):
+    """Aggregate device-op durations from a profiler capture."""
+    trace_file = find_trace_file(path)
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    total = collections.Counter()
+    count = collections.Counter()
+    long_names = {}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        name = e.get("name", "")
+        if not _is_device_op(name):
+            continue
+        total[name] += e["dur"]
+        count[name] += 1
+        if not long_names.get(name):
+            args = e.get("args") or {}
+            long_names[name] = (args.get("long_name") or
+                                args.get("hlo_op") or "")
+    rows = [OpRow(n, n.split(".")[0], total[n] / 1e3, count[n],
+                  long_names.get(n, ""))
+            for n in total]
+    return TraceSummary(rows)
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Summarize device-op time from a jax.profiler trace")
+    p.add_argument("path", help="profiler output dir or trace file")
+    p.add_argument("-n", type=int, default=20, help="rows to print")
+    args = p.parse_args(argv)
+    summary = summarize_trace(args.path)
+    print(f"device-op total: {summary.total_ms:.1f} ms "
+          f"({len(summary.rows)} distinct ops)")
+    print("-- by group")
+    for group, ms in summary.by_group()[:10]:
+        print(f"{ms:9.3f} ms  {group}")
+    print("-- top ops")
+    for row in summary.top(args.n):
+        print(row)
+
+
+if __name__ == "__main__":
+    main()

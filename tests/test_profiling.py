@@ -1,0 +1,75 @@
+"""Device-trace summarization (utils/profiling.py): aggregation,
+filtering of host-side spans, group totals, and file discovery."""
+
+import gzip
+import json
+
+import pytest
+
+from horovod_tpu.utils import profiling
+
+
+def _write_trace(tmp_path, events, gz=True):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    payload = json.dumps({"traceEvents": events})
+    p = d / ("t.trace.json.gz" if gz else "t.trace.json")
+    if gz:
+        with gzip.open(p, "wt") as f:
+            f.write(payload)
+    else:
+        p.write_text(payload)
+    return tmp_path
+
+
+def _ev(name, dur, **args):
+    e = {"ph": "X", "name": name, "dur": dur, "ts": 0}
+    if args:
+        e["args"] = args
+    return e
+
+
+class TestSummarizeTrace:
+    def test_aggregates_and_filters(self, tmp_path):
+        root = _write_trace(tmp_path, [
+            _ev("fusion.1", 1000, long_name="%fusion.1 = f32[8]"),
+            _ev("fusion.1", 500),
+            _ev("fusion.2", 2000),
+            _ev("attn.3", 4000),
+            _ev("$python_span", 99999),        # host-side: excluded
+            _ev("jit_step(123)", 99999),       # dispatch wrapper: excluded
+            _ev("2", 99999),                   # step-group lane: excluded
+            {"ph": "M", "name": "meta"},       # not a complete event
+        ])
+        s = profiling.summarize_trace(str(root))
+        by_name = {r.name: r for r in s.rows}
+        assert set(by_name) == {"fusion.1", "fusion.2", "attn.3"}
+        assert by_name["fusion.1"].total_ms == pytest.approx(1.5)
+        assert by_name["fusion.1"].count == 2
+        assert by_name["fusion.1"].long_name.startswith("%fusion.1")
+        assert s.total_ms == pytest.approx(7.5)
+        # sorted by total, groups aggregate fusion.1 + fusion.2
+        assert s.rows[0].name == "attn.3"
+        assert dict(s.by_group()) == pytest.approx(
+            {"fusion": 3.5, "attn": 4.0})
+
+    def test_find_trace_file_errors(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="trace.json"):
+            profiling.find_trace_file(str(tmp_path))
+
+    def test_uncompressed_trace_discovered(self, tmp_path):
+        root = _write_trace(tmp_path, [
+            _ev("f.1", 250, long_name=""),      # args-less long_name...
+            _ev("f.1", 250, long_name="%f.1"),  # ...backfilled later
+        ], gz=False)
+        s = profiling.summarize_trace(str(root))
+        (row,) = s.rows
+        assert row.total_ms == pytest.approx(0.5)
+        assert row.long_name == "%f.1"
+
+    def test_cli_main(self, tmp_path, capsys):
+        root = _write_trace(tmp_path, [_ev("fusion.9", 1500)])
+        profiling.main([str(root), "-n", "5"])
+        out = capsys.readouterr().out
+        assert "device-op total: 1.5 ms" in out
+        assert "fusion.9" in out
