@@ -134,4 +134,8 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:  # `... | head` closed the pipe: not an error
+        import sys
+        sys.exit(0)
