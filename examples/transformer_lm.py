@@ -53,7 +53,8 @@ def parse_args():
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel ways (ring/ulysses attention)")
     p.add_argument("--attention", default="full",
-                   choices=["full", "ring", "ulysses", "flash"])
+                   choices=["full", "ring", "ring_flash", "ulysses",
+                            "flash"])
     p.add_argument("--batch-size", type=int, default=4,
                    help="per-dp-way batch size")
     p.add_argument("--seq-len", type=int, default=None)

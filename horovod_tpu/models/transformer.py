@@ -83,7 +83,7 @@ def _dispatch_attention(cfg, q, k, v, sp):
     sequence-sharding axis (None when the sequence is whole on this
     worker)."""
     from ..parallel import ring
-    known = ("full", "ring", "ulysses", "flash")
+    known = ("full", "ring", "ring_flash", "ulysses", "flash")
     if cfg.attention_impl not in known:
         raise ValueError(
             f"Unknown attention_impl={cfg.attention_impl!r}; "
@@ -91,14 +91,19 @@ def _dispatch_attention(cfg, q, k, v, sp):
     if sp is not None:
         if cfg.attention_impl == "ring":
             return ring.ring_attention(q, k, v, axis_name=sp, causal=True)
+        if cfg.attention_impl == "ring_flash":
+            return ring.ring_flash_attention(q, k, v, axis_name=sp,
+                                             causal=True)
         if cfg.attention_impl == "ulysses":
             return ring.ulysses_attention(q, k, v, axis_name=sp, causal=True)
         raise ValueError(
             "The sequence is sharded over the 'sp' mesh axis but "
             f"attention_impl={cfg.attention_impl!r} cannot attend across "
-            "shards — construct the model with attention_impl='ring' or "
-            "'ulysses' for sequence parallelism.")
-    if cfg.attention_impl == "flash":
+            "shards — construct the model with attention_impl='ring', "
+            "'ring_flash', or 'ulysses' for sequence parallelism.")
+    if cfg.attention_impl in ("flash", "ring_flash"):
+        # ring_flash with the whole sequence on this worker: the flash
+        # kernel IS the single-block ring
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True)
     return ring.full_attention(q, k, v, causal=True)

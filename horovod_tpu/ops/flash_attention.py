@@ -42,6 +42,21 @@ def _auto_interpret():
     return jax.default_backend() != "tpu"
 
 
+def _out_struct(shape, dtype, *like):
+    """ShapeDtypeStruct matching the operands' varying-manual-axes type,
+    so the kernels compose with shard_map (check_vma=True requires
+    outputs to declare how they vary — e.g. ring attention calls these
+    kernels on sequence-sharded blocks)."""
+    vma = None
+    for t in like:
+        tv = getattr(getattr(t, "aval", None), "vma", None)
+        if tv:
+            vma = tv if vma is None else (vma | tv)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # both grid dims are independent (programs share no state): 'parallel'
 # lets Mosaic software-pipeline across grid steps instead of flushing
 # between them
@@ -181,8 +196,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
             pl.BlockSpec((1, 8, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 8, sq), jnp.float32),
+            _out_struct((b * h, sq, d), q.dtype, qf, kf, vf),
+            _out_struct((b * h, 8, sq), jnp.float32, qf, kf, vf),
         ],
         interpret=interpret if interpret is not None else _auto_interpret(),
     )(qf, kf, vf)
@@ -357,7 +372,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=_out_struct((b * h, sq, d), q.dtype, qf, dof, lse,
+                              delta, kf, vf),
         interpret=interpret,
     )(qf, dof, lse, delta, kf, vf)
 
@@ -379,8 +395,10 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            _out_struct((b * h, sk, d), k.dtype, kf, vf, qf, dof, lse,
+                        delta),
+            _out_struct((b * h, sk, d), v.dtype, kf, vf, qf, dof, lse,
+                        delta),
         ],
         interpret=interpret,
     )(kf, vf, qf, dof, lse, delta)
@@ -389,6 +407,18 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
         return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
     return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+
+
+def fit_block(block, s):
+    """Largest block ≤ requested that divides the sequence, halving no
+    further than 128 (the MXU-friendly floor) — a larger default must
+    not reject lengths like 384 that 128-blocks handled. The single
+    block-size policy for this kernel and its compositions
+    (parallel/ring.py ring_flash_attention)."""
+    b = min(block, s)
+    while b > 128 and s % b:
+        b //= 2
+    return b
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -418,16 +448,6 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
     sq, sk = q.shape[1], k.shape[1]
     d = q.shape[-1]
     scale = d ** -0.5
-
-    def fit_block(block, s):
-        # largest block ≤ requested that divides the sequence, halving no
-        # further than 128 (the MXU-friendly floor) — a 256 default must
-        # not reject lengths like 384 that 128-blocks handled
-        b = min(block, s)
-        while b > 128 and s % b:
-            b //= 2
-        return b
-
     bq, bk = fit_block(block_q, sq), fit_block(block_k, sk)
     pad_q, pad_k = -sq % bq, -sk % bk
     if (pad_q or pad_k) and not (causal and sq == sk):

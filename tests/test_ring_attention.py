@@ -90,3 +90,87 @@ def test_ring_attention_grad_flows(hvd):
         out_specs=P(None, "sp")))(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
                                rtol=1e-4, atol=1e-4)
+
+
+class TestRingFlash:
+    """ring_flash_attention: the ring with the Pallas flash kernel as
+    the per-pair engine (fwd + custom-vjp bwd) — numerics must match the
+    exact full attention, like ring_attention."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full(self, hvd, causal):
+        from horovod_tpu.parallel import ring
+        q, k, v = _make_qkv()
+        expect = ring.full_attention(q, k, v, causal=causal)
+        got = _run_sp(hvd, lambda a, b, c: ring.ring_flash_attention(
+            a, b, c, axis_name="sp", causal=causal), q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_long_sequence_shards(self, hvd):
+        from horovod_tpu.parallel import ring
+        q, k, v = _make_qkv(b=1, s=128, h=2, d=4, seed=1)
+        expect = ring.full_attention(q, k, v, causal=True)
+        got = _run_sp(hvd, lambda a, b, c: ring.ring_flash_attention(
+            a, b, c), q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_full(self, hvd, causal):
+        """dq/dk/dv through the two-ring custom vjp vs autodiff of the
+        exact full attention."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from horovod_tpu.parallel import ring
+        q, k, v = _make_qkv(b=1, s=32, h=2, d=4, seed=3)
+
+        def loss_full(q, k, v):
+            return jnp.sum(ring.full_attention(q, k, v,
+                                               causal=causal) ** 2)
+
+        g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring.ring_flash_attention(
+                q, k, v, causal=causal) ** 2)
+
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        g_ring = jax.jit(jax.shard_map(
+            jax.grad(loss_ring, argnums=(0, 1, 2)), mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=(P(None, "sp"),) * 3))(q, k, v)
+        for got, want, name in zip(g_ring, g_full, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4,
+                err_msg=f"d{name} mismatch")
+
+
+def test_ulysses_grad_matches_full(hvd):
+    """Ulysses gradients (plain autodiff through the all-to-alls) vs the
+    full-attention gradient — completing the values-AND-gradients
+    coverage claim for all three sp attention variants."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.parallel import ring
+    q, k, v = _make_qkv(b=1, s=32, h=8, d=4, seed=5)
+
+    def loss_full(q, k, v):
+        return jnp.sum(ring.full_attention(q, k, v) ** 2)
+
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ring.ulysses_attention(q, k, v) ** 2)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+    g_uly = jax.jit(jax.shard_map(
+        jax.grad(loss_uly, argnums=(0, 1, 2)), mesh=mesh,
+        in_specs=(P(None, "sp"),) * 3,
+        out_specs=(P(None, "sp"),) * 3))(q, k, v)
+    for got, want, name in zip(g_uly, g_full, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name} mismatch")

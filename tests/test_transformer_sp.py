@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 def test_sp_forward_matches_full(hvd, impl):
     import dataclasses
     import jax
