@@ -37,6 +37,10 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # share the input embedding matrix with the lm_head (GPT-2 ties
+    # them); saves d_model*vocab params and the separate head-matrix
+    # optimizer update, and removes one [vocab, d] gradient scatter-add
+    tie_embeddings: bool = False
     # 'full' (default), 'ring', or 'ulysses': how attention handles a
     # sequence-sharded input. ring/ulysses take effect when the model runs
     # inside shard_map with the 'sp' axis bound (parallel/ring.py); under
@@ -189,8 +193,9 @@ class TransformerLM(nn.Module):
         hidden states [B, S, d_model] instead — the pre-head activations the
         chunked-vocab loss consumes without materializing the logits."""
         cfg = self.cfg
-        x = nn.Embed(cfg.vocab_size, cfg.d_model,
-                     dtype=cfg.dtype, name="embed")(tokens)
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                         dtype=cfg.dtype, name="embed")
+        x = embed(tokens)
         s_loc = tokens.shape[1]
         sp = _active_sp_axis(tokens)
         if sp is not None:
@@ -206,8 +211,11 @@ class TransformerLM(nn.Module):
             x = block(cfg, sp=sp, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
-            # lm_head params still exist: init() runs the default path
+            # head params (lm_head, or the tied embedding) still exist:
+            # init() runs the default path
             return x
+        if cfg.tie_embeddings:
+            return embed.attend(x.astype(cfg.dtype)).astype(jnp.float32)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         name="lm_head")(x).astype(jnp.float32)
 
@@ -238,10 +246,21 @@ def param_specs(params):
 
     Unmatched leaves are replicated. Feed to
     jax.jit(in_shardings=...)/NamedSharding over a mesh with a 'tp' axis.
+
+    Tied-embedding models (no ``lm_head`` in the tree) shard the
+    embedding over 'tp' on the VOCAB axis, so it keeps playing the
+    vocab-sharded-head role the separate lm_head rule encodes — without
+    it, a tp mesh would materialize the full [B, S, vocab] fp32 logits
+    on every shard. GSPMD handles the token-id gather against the
+    vocab-sharded table on the input side.
     """
+    tied = "lm_head" not in params
+
     def spec_for(path, leaf):
         names = tuple(str(getattr(p, "key", getattr(p, "name", p)))
                       for p in path)
+        if tied and names[-2:] == ("embed", "embedding"):
+            return P("tp", None)
         for suffix, spec in _TP_RULES:
             if names[-len(suffix):] == suffix:
                 return spec
@@ -332,6 +351,13 @@ def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
     """
     from .. import trainer as trainer_mod
 
+    def head_kernel(params):
+        """[d_model, vocab] head matrix for the chunked-CE path —
+        the tied embedding transposed, or the separate lm_head."""
+        if model.cfg.tie_embeddings:
+            return params["embed"]["embedding"].T
+        return params["lm_head"]["kernel"]
+
     def loss_fn(params, tokens):
         # Full-length inputs keep the sequence dim tile-aligned: a 1024
         # sequence runs every matmul at 1024, where the classic
@@ -353,7 +379,7 @@ def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
                                           return_hidden=True,
                                           mutable=["losses"])
                 ce = chunked_softmax_cross_entropy(
-                    hidden, params["lm_head"]["kernel"], targets,
+                    hidden, head_kernel(params), targets,
                     chunk=vocab_chunk, weights=weights)
             else:
                 logits, mut = model.apply({"params": params}, inputs,
@@ -365,7 +391,7 @@ def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
             hidden = model.apply({"params": params}, inputs,
                                  return_hidden=True)
             return chunked_softmax_cross_entropy(
-                hidden, params["lm_head"]["kernel"], targets,
+                hidden, head_kernel(params), targets,
                 chunk=vocab_chunk, weights=weights)
         logits = model.apply({"params": params}, inputs)
         return trainer_mod.softmax_cross_entropy(logits, targets, weights)

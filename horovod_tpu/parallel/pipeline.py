@@ -152,6 +152,13 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
             "through the pipeline (the sown 'losses' collection would be "
             "silently dropped inside lax.scan); use make_gspmd_step with "
             "models.transformer.lm_loss_fn for MoE configs.")
+    if cfg.tie_embeddings:
+        raise NotImplementedError(
+            "make_pipeline_step does not support tie_embeddings: the "
+            "embedding lives on the first stage and the head on the "
+            "last, so tying needs a cross-stage weight exchange; use "
+            "make_gspmd_step, or an untied config, for pipeline "
+            "parallelism.")
     block = Block(cfg, sp=None)
     ln_f = nn.RMSNorm(dtype=cfg.dtype)
 

@@ -145,3 +145,37 @@ def test_gspmd_transformer_step_multi_axis(hvd):
     multi-axis path dryrun_multichip exercises."""
     import __graft_entry__ as graft
     graft.dryrun_multichip(8)
+
+
+class TestTiedEmbeddings:
+    def test_tied_head_uses_embedding(self, hvd):
+        """tie_embeddings=True: no separate lm_head params; logits are
+        hidden @ embedding.T; dense and chunked losses agree; gradients
+        reach the shared matrix from both uses."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from horovod_tpu.models import transformer as tr
+
+        cfg = tr.TransformerConfig.tiny(tie_embeddings=True)
+        model = tr.TransformerLM(cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)),
+            jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        assert "lm_head" not in params
+        logits = model.apply({"params": params}, toks)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        # logits really are hidden @ embedding.T
+        hidden = model.apply({"params": params}, toks, return_hidden=True)
+        want = hidden @ params["embed"]["embedding"].T.astype(hidden.dtype)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(want, np.float32),
+                                   rtol=1e-5, atol=1e-5)
+        dense = tr.lm_loss_fn(model)(params, toks)
+        chunked = tr.lm_loss_fn(model, vocab_chunk=64)(params, toks)
+        np.testing.assert_allclose(float(dense), float(chunked),
+                                   rtol=1e-5)
+        g = jax.grad(tr.lm_loss_fn(model))(params, toks)
+        emb_g = np.asarray(g["embed"]["embedding"])
+        assert np.isfinite(emb_g).all() and np.abs(emb_g).sum() > 0
