@@ -387,3 +387,83 @@ class TestShutdownDrain:
         results = run(fn, num_proc=2, env=_ENV, start_timeout_s=120.0)
         assert results[1] == "shutdown-drained"
         assert results[0] == 3.0, results
+
+
+class TestPoisonGrace:
+    """Control-plane loss declaration (ops/eager.py): >=3 failed cycles
+    alone must NOT poison the plane — only >=3 failures sustained for
+    POISON_GRACE_S (transient coordinator pauses and TCP resets at the
+    5 ms cycle cadence must not tear the job down in ~15 ms)."""
+
+    def _coordinator_with_failing_negotiator(self):
+        import time as _time
+
+        import horovod_tpu as hvd
+        from horovod_tpu.common import state
+
+        hvd.init()
+        coord = state.global_state().coordinator
+        coord._paused = True  # keep the background loop out of the way
+
+        class FailingNegotiator:
+            calls = 0
+
+            def cycle(self, *a, **kw):
+                FailingNegotiator.calls += 1
+                raise ConnectionRefusedError("synthetic control-plane loss")
+
+            def close(self):
+                pass
+
+        coord._negotiator = FailingNegotiator()
+        return hvd, coord
+
+    def test_three_fast_failures_do_not_poison(self):
+        hvd, coord = self._coordinator_with_failing_negotiator()
+        try:
+            for _ in range(5):
+                coord._cycle_backoff_until = 0.0  # bypass waiting
+                coord._negotiated_flush_locked()
+            assert coord._cycle_failures >= 3
+            assert not coord._negotiation_dead, (
+                "fast consecutive failures must not poison the plane "
+                "before POISON_GRACE_S elapses")
+            assert coord._cycle_backoff_until > 0  # backoff engaged
+        finally:
+            coord._negotiator = None
+            hvd.shutdown()
+
+    def test_sustained_unreachability_poisons(self):
+        import time
+
+        hvd, coord = self._coordinator_with_failing_negotiator()
+        try:
+            coord._cycle_backoff_until = 0.0
+            coord._negotiated_flush_locked()  # first failure stamps since
+            # simulate the grace window having elapsed
+            coord._cycle_fail_since = (time.monotonic() -
+                                       coord.POISON_GRACE_S - 1.0)
+            for _ in range(3):
+                coord._cycle_backoff_until = 0.0
+                coord._negotiated_flush_locked()
+            assert coord._negotiation_dead
+        finally:
+            coord._negotiator = None
+            hvd.shutdown()
+
+    def test_backoff_defers_cycles(self):
+        import time
+
+        hvd, coord = self._coordinator_with_failing_negotiator()
+        try:
+            coord._cycle_backoff_until = 0.0
+            coord._negotiated_flush_locked()
+            calls_after_first = type(coord._negotiator).calls
+            # backoff window is active: the next flush must not hit the
+            # negotiator at all
+            coord._negotiated_flush_locked()
+            assert type(coord._negotiator).calls == calls_after_first
+            assert coord._cycle_backoff_until > time.monotonic() - 2.0
+        finally:
+            coord._negotiator = None
+            hvd.shutdown()
