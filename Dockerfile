@@ -1,0 +1,28 @@
+# Dev + CI image for horovod_tpu (role of the reference's Dockerfile /
+# Dockerfile.test.cpu, /root/reference/Dockerfile:1-70 — there a
+# CUDA+MPI build box; here a CPU box that runs the full suite on the
+# virtual 8-device mesh. On a TPU VM, install the matching libtpu jax
+# wheel instead of the CPU one and the same image serves for real-chip
+# runs.)
+#
+#   docker build -t horovod-tpu .
+#   docker run --rm horovod-tpu                      # full CI pipeline
+#   docker run --rm horovod-tpu python -m pytest tests/ -q
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential g++ make git openssh-client \
+    && rm -rf /var/lib/apt/lists/*
+
+# jax[cpu]: tests force the virtual CPU mesh; swap for jax[tpu] on TPU VMs
+RUN pip install --no-cache-dir \
+        "jax[cpu]" flax optax orbax-checkpoint chex einops numpy pytest \
+        tensorflow-cpu keras torch --index-url https://pypi.org/simple
+
+WORKDIR /workspace/horovod_tpu
+COPY . .
+
+# build the native core (planner/cache/timeline/autotuner C++)
+RUN python setup.py build_native
+
+CMD ["ci/run_tests.sh"]

@@ -167,6 +167,18 @@ def main():
         print(f"final loss {float(loss):.4f}")
         print(f"{tps:,.0f} tokens/sec total ({tps / n:,.0f}/chip, "
               f"{ms:.1f} ms/step)")
+        if args.bench and args.sp > 1:
+            # ring/Ulysses sequence parallelism: per-chip residency and
+            # wire volume scale with seq/sp, so the measured single-chip
+            # envelope (docs/benchmarks.md) projects to sp x that length
+            # on a ring of sp chips
+            h = cfg.num_heads
+            hd = cfg.d_model // h
+            blk = (batch // dp) * (seq // args.sp) * h * hd * 2  # bf16
+            print(f"sp={args.sp}: seq/chip {seq // args.sp} of {seq} "
+                  f"global; ring hop payload {2 * blk / 2 ** 20:.1f} MiB "
+                  f"(K+V); projected envelope ≈ sp x single-chip "
+                  f"(same per-chip residency)")
 
 
 if __name__ == "__main__":

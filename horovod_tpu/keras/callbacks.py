@@ -55,6 +55,49 @@ class MetricAverageCallback(_Base):
                     np.float32(v), average=True, name=f"metric.{k}")))
 
 
+class _MomentumVariable(float):
+    """Backs a plain-float ``optimizer.momentum`` with a live Variable so
+    momentum correction reaches compiled train steps.
+
+    Keras 3 optimizers (e.g. default SGD) keep momentum as a python
+    float: compiled train functions bake it in as a constant at trace
+    time and per-batch mutation silently does nothing. Swapping in this
+    wrapper before the first trace gives the graph a read of a real
+    Variable (``assign`` takes effect on every subsequent step, no
+    retrace).
+
+    It subclasses ``float`` so everything outside the traced step keeps
+    working untouched: Keras' build-time ``if self.momentum != 0`` runs
+    inside a tf.function where a symbolic bool raises, and
+    ``get_config()``/``model.save()`` must serialize momentum as a plain
+    number. The float base value is the UNCORRECTED momentum — assign()
+    only ever swings it for the duration of one adjusted batch
+    (correction then restore, _adjust_learning_rate), so the stable
+    float view is also the right value to persist."""
+
+    def __new__(cls, variable):
+        return super().__new__(cls, float(np.asarray(variable)))
+
+    def __init__(self, variable):
+        self.variable = variable
+
+    def assign(self, value):
+        self.variable.assign(value)
+
+    def __repr__(self):
+        return f"_MomentumVariable({float(self)!r})"
+
+    # tensor-conversion hooks: ops.cast(momentum, ...) inside a traced
+    # step must read the VARIABLE, not a constant
+    def __tf_tensor__(self, dtype=None, name=None):
+        import tensorflow as tf
+        t = tf.convert_to_tensor(self.variable.value)
+        return tf.cast(t, dtype) if dtype is not None else t
+
+    def __jax_array__(self):
+        return self.variable.value
+
+
 class LearningRateScheduleCallback(_Base):
     """LR = initial_lr * multiplier(epoch), staircase or continuous, with
     momentum correction m *= new_lr/old_lr during the adjusted batch
@@ -97,20 +140,40 @@ class LearningRateScheduleCallback(_Base):
         m = getattr(self.model.optimizer, "momentum", None)
         return m is not None and hasattr(m, "assign")
 
-    _momentum_warned = False
-
-    def _warn_momentum_once(self):
-        if not LearningRateScheduleCallback._momentum_warned:
-            LearningRateScheduleCallback._momentum_warned = True
-            import warnings
-            warnings.warn(
-                "momentum correction skipped: this optimizer stores "
-                "momentum as a plain float, which compiled train steps "
-                "bake in at trace time (set run_eagerly=True or use an "
-                "optimizer with a momentum Variable).")
+    def _ensure_momentum_variable(self):
+        """Rebuild a plain-float ``optimizer.momentum`` as a tracked
+        Variable (_MomentumVariable) so correction reaches compiled
+        steps. Runs on_train_begin — before the first trace — and drops
+        any stale compiled train function so the swap cannot race a
+        cached trace. No-op for zero/absent momentum or optimizers that
+        already hold a Variable."""
+        if not self.momentum_correction:
+            return
+        opt = self.model.optimizer
+        m = getattr(opt, "momentum", None)
+        if m is None or self._momentum_is_variable() or not float(m):
+            return
+        import keras
+        var = keras.Variable(float(m), dtype="float32", trainable=False,
+                             name="momentum")
+        # track it so backends that thread optimizer state through the
+        # compiled step (jax) carry it
+        track = getattr(opt, "_track_variable", None)
+        if track is not None:
+            track(var)
+        opt.momentum = _MomentumVariable(var)
+        # rebuild the compiled train function: an earlier fit() may have
+        # already traced with the float momentum baked in
+        make = getattr(self.model, "make_train_function", None)
+        if make is not None:
+            make(force=True)
 
     def _set_momentum(self, m):
-        self.model.optimizer.momentum = m
+        cur = self.model.optimizer.momentum
+        if hasattr(cur, "assign"):
+            cur.assign(m)
+        else:  # eager / uncompiled path
+            self.model.optimizer.momentum = m
 
     def _adjust_learning_rate(self, epoch):
         old_lr = self._get_lr()
@@ -118,9 +181,6 @@ class LearningRateScheduleCallback(_Base):
         self._set_lr(new_lr)
         momentum = self._get_momentum()
         if momentum and self.momentum_correction and old_lr:
-            if not self._momentum_is_variable():
-                self._warn_momentum_once()
-                return
             self.restore_momentum = momentum
             self._set_momentum(momentum * new_lr / old_lr)
 
@@ -131,6 +191,7 @@ class LearningRateScheduleCallback(_Base):
 
     def on_train_begin(self, logs=None):
         self.initial_lr = self._get_lr()
+        self._ensure_momentum_variable()
         if not self.staircase and not self.steps_per_epoch:
             params = getattr(self, "params", None) or {}
             self.steps_per_epoch = params.get("steps")

@@ -36,6 +36,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 
 def _auto_interpret():
@@ -90,18 +92,35 @@ def _wait_all(streams, slot, i):
 
 def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
                 seq_k, causal, scale):
+    """Online-softmax forward. The inner loop is deliberately VPU-lean —
+    the softmax chain, not the matmuls, is the measured bottleneck at
+    head_dim 64/128: it runs in the exp2 domain with log2(e) folded into
+    the scalar logit scale (one exp2 pass per tile, no hidden ln2
+    multiplies); lse converts back to natural log once at the end (the
+    external contract — parallel/ring.py merges in natural-log units).
+
+    Measured dead ends on v5e (b8 s1024 h12 d64, see
+    tools/flash_microbench.py): folding the softmax scale into q;
+    lax.cond-skipping the causal mask on fully-visible tiles; carrying
+    the row-sum in a planted ones-lane of v's head-dim padding (the MXU
+    computes l for free but the end-of-loop lane extract costs more than
+    the per-tile VPU reduction it saves, +25%); a manual 1-deep software
+    pipeline of the next tile's logits matmul against the current tile's
+    softmax (the [block_q, block_k] fp32 logits carry spills, +50%); and
+    the stock jax.experimental pallas flash kernel's grid-over-kv design
+    (2.7x slower end-to-end at this shape). Straight-line + fori_loop
+    with double-buffered manual DMA is the fastest form found.
+    """
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     # matmul operands stay in the input dtype (bf16 runs the MXU at full
     # rate; fp32 would quarter it on v5e) — accumulation is fp32 via
     # preferred_element_type, softmax statistics are fp32 throughout.
-    # (Measured dead ends on v5e: folding the softmax scale into q, and
-    # lax.cond-skipping the causal mask on fully-visible tiles — both
-    # slower than this straight-line form; Mosaic pipelines it best.)
     q = q_ref[0]                                # [block_q, d]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
+    scale2 = scale * _LOG2E                     # logits in log2 units
 
     nk_total = seq_k // block_k
     if causal:
@@ -128,14 +147,15 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
             _wait_all(streams, slot, kb)
             k = k_scr[slot]
             v = v_scr[slot]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            s = jnp.dot(q, k.T,
+                        preferred_element_type=jnp.float32) * scale2
             if causal:
                 k_pos = kb * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            p = jnp.exp(s - m_new[:, None])
-            alpha = jnp.exp(m - m_new)
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1)
             acc = acc * alpha[:, None] + jnp.dot(
                 p.astype(v.dtype), v, preferred_element_type=jnp.float32)
@@ -147,10 +167,11 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
         m, l, acc = jax.lax.fori_loop(0, nk, body, init)
         l = jnp.clip(l, 1e-30)
         o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-        # per-row log-sum-exp (the backward's softmax residual), replicated
-        # over an 8-row sublane dim to satisfy the TPU (8, 128) tile rule
-        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[None, :],
-                                      (8, m.shape[0]))
+        # per-row log-sum-exp in NATURAL log (the backward's softmax
+        # residual and ring.py's merge contract), replicated over an
+        # 8-row sublane dim to satisfy the TPU (8, 128) tile rule
+        lse_ref[0] = jnp.broadcast_to(
+            ((m + jnp.log2(l)) * _LN2)[None, :], (8, m.shape[0]))
 
     pl.run_scoped(
         scoped,
@@ -207,16 +228,22 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
 def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
                block_q, block_k, seq_k, causal, scale):
     """dQ, gridded like the forward: one (batch·head, q-block) per program,
-    K/V streamed from HBM. ds = p ∘ (dP − delta); dq = scale · ds @ K."""
+    K/V streamed from HBM. ds = p ∘ (dP − delta); dq = scale · ds @ K.
+
+    VPU-lean like the forward: p re-materializes via exp2 against the
+    log2-domain lse, and the constant logit scale moves out of the
+    per-tile ds (a [bq, bk] multiply) onto the accumulated dq after the
+    loop (a [bq, d] multiply, once)."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     q = q_ref[0]               # input dtype into the MXU (see _fwd_kernel)
     do = do_ref[0]
-    lse = lse_ref[0, 0]        # row 0 of the 8-way replicated sublane dim
+    lse2 = lse_ref[0, 0] * _LOG2E   # row 0 of the replicated sublane dim
     delta = delta_ref[0, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
+    scale2 = scale * _LOG2E
 
     nk_total = seq_k // block_k
     if causal:
@@ -240,19 +267,19 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
             _wait_all(streams, slot, kb)
             k = k_scr[slot]
             v = v_scr[slot]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale2
             if causal:
                 k_pos = kb * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-            p = jnp.exp(s - lse[:, None])
+            p = jnp.exp2(s - lse2[:, None])
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+            ds = (p * (dp - delta[:, None])).astype(k.dtype)
             return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
         dq = jax.lax.fori_loop(0, nk, body,
                                jnp.zeros((block_q, d), jnp.float32))
-        dq_ref[0] = dq.astype(dq_ref.dtype)
+        dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
     pl.run_scoped(
         scoped,
@@ -265,7 +292,9 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref, *,
 def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
                 dv_ref, *, block_q, block_k, seq_q, causal, scale):
     """dK/dV, gridded over (batch·head, k-block), Q/dO/lse/delta streamed
-    from HBM; for causal the Q loop starts at the diagonal block."""
+    from HBM; for causal the Q loop starts at the diagonal block.
+    Same VPU-lean scheme as _dq_kernel: exp2 against log2-lse, logit
+    scale applied to dk once after the loop."""
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     d = k_ref.shape[-1]
@@ -273,6 +302,7 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
     v = v_ref[0]
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
+    scale2 = scale * _LOG2E
 
     nq_total = seq_q // block_q
     if causal:
@@ -302,19 +332,19 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
             _wait_all(streams, slot, qb)
             q = q_scr[slot]
             do = do_scr[slot]
-            lse = lse_scr[slot, 0]     # row 0 of the replicated sublanes
+            lse2 = lse_scr[slot, 0] * _LOG2E   # row 0 of replicated rows
             delta = delta_scr[slot, 0]
 
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale2
             if causal:
                 q_pos = qb * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
                 s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-            p = jnp.exp(s - lse[:, None])                  # [bq, bk]
+            p = jnp.exp2(s - lse2[:, None])                # [bq, bk]
             dv = dv + jnp.dot(p.astype(do.dtype).T, do,
                               preferred_element_type=jnp.float32)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
             dk = dk + jnp.dot(ds.T, q,
                               preferred_element_type=jnp.float32)
             return dk, dv
@@ -322,7 +352,7 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
         init = (jnp.zeros((block_k, d), jnp.float32),
                 jnp.zeros((block_k, d), jnp.float32))
         dk, dv = jax.lax.fori_loop(qb_start, nq_total, body, init)
-        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv.astype(dv_ref.dtype)
 
     pl.run_scoped(
@@ -338,11 +368,20 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
-               scale=None):
+               scale=None, block_q_dkv=None, block_k_dkv=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    # the dK/dV kernel streams Q-side tiles and grids over K blocks —
+    # its optimal tile shape need not match the dQ kernel's, so the two
+    # are independently tunable (tools/flash_microbench.py --sweep-dkv)
+    block_q_dkv = min(block_q_dkv or block_q, sq)
+    block_k_dkv = min(block_k_dkv or block_k, sk)
+    if sq % block_q_dkv:
+        block_q_dkv = block_q     # caller-validated fallback
+    if sk % block_k_dkv:
+        block_k_dkv = block_k
     if scale is None:
         scale = d ** -0.5
     interpret = interpret if interpret is not None else _auto_interpret()
@@ -377,22 +416,23 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
         interpret=interpret,
     )(qf, dof, lse, delta, kf, vf)
 
+    bq2, bk2 = block_q_dkv, block_k_dkv
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
+        functools.partial(_dkv_kernel, block_q=bq2, block_k=bk2,
                           seq_q=sq, causal=causal, scale=scale),
-        grid=(b * h, sk // block_k),
+        grid=(b * h, sk // bk2),
         compiler_params=_COMPILER_PARAMS,
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk2, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk2, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk2, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk2, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             _out_struct((b * h, sk, d), k.dtype, kf, vf, qf, dof, lse,
@@ -421,15 +461,16 @@ def fit_block(block, s):
     return b
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, causal, block_q, block_k, interpret, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret, scale,
+                block_q_dkv, block_k_dkv):
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
                         scale=scale)
     return out
 
 
 def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
-                    interpret=None):
+                    interpret=None, block_q_dkv=None, block_k_dkv=None):
     """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
     global positions. Numerically equivalent to
     parallel.ring.full_attention (exact softmax, fp32 accumulation), in
@@ -449,6 +490,8 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
     d = q.shape[-1]
     scale = d ** -0.5
     bq, bk = fit_block(block_q, sq), fit_block(block_k, sk)
+    bq2 = fit_block(block_q_dkv, sq) if block_q_dkv else None
+    bk2 = fit_block(block_k_dkv, sk) if block_k_dkv else None
     pad_q, pad_k = -sq % bq, -sk % bk
     if (pad_q or pad_k) and not (causal and sq == sk):
         raise ValueError(
@@ -463,22 +506,26 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
     if pad_d:
         pads = ((0, 0), (0, 0), (0, 0), (0, pad_d))
         q, k, v = jnp.pad(q, pads), jnp.pad(k, pads), jnp.pad(v, pads)
-    out = _flash_core(q, k, v, causal, bq, bk, interpret_eff, scale)
+    out = _flash_core(q, k, v, causal, bq, bk, interpret_eff, scale,
+                      bq2, bk2)
     if pad_d:
         out = out[..., :d]
     return out[:, :sq] if pad_q else out
 
 
-def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret, scale):
+def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret, scale,
+             block_q_dkv, block_k_dkv):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
                           scale=scale)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, block_q, block_k, interpret, scale, residuals, g):
+def _vjp_bwd(causal, block_q, block_k, interpret, scale, block_q_dkv,
+             block_k_dkv, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
-                      interpret, scale=scale)
+                      interpret, scale=scale, block_q_dkv=block_q_dkv,
+                      block_k_dkv=block_k_dkv)
 
 
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)

@@ -109,20 +109,47 @@ def unstack_pipeline_params(pparams, num_layers):
     return out
 
 
-def pipeline_param_specs(pparams):
+# Megatron-style TP rules for the STACKED layer layout: the leading dim
+# is the pp-sharded layer axis, then models/transformer.py's _TP_RULES
+# shifted right by one (column-parallel qkv/gate/up, row-parallel
+# out/down).
+_STACKED_TP_RULES = (
+    (("attn", "qkv", "kernel"), P("pp", None, "tp")),
+    (("attn", "out", "kernel"), P("pp", "tp", None)),
+    (("mlp", "gate", "kernel"), P("pp", None, "tp")),
+    (("mlp", "up", "kernel"), P("pp", None, "tp")),
+    (("mlp", "down", "kernel"), P("pp", "tp", None)),
+)
+
+
+def pipeline_param_specs(pparams, tp=False):
     """PartitionSpecs for the pipeline layout: layer stack sharded over
-    'pp' on the leading axis, everything else replicated."""
+    'pp' on the leading axis, everything else replicated.
+
+    ``tp=True`` additionally shards the stacked layer kernels and the
+    lm_head over the 'tp' mesh axis (Megatron column/row parallelism,
+    same rules as models.transformer.param_specs) — the placement side
+    of the combined dp x pp x tp step (make_pipeline_step leaves 'tp'
+    out of shard_map's manual axes, so GSPMD inserts the tp
+    collectives)."""
     def spec(path, leaf):
-        top = str(getattr(path[0], "key", getattr(path[0], "name", path[0])))
-        if top == "layers":
+        names = tuple(str(getattr(p, "key", getattr(p, "name", p)))
+                      for p in path)
+        if names[0] == "layers":
+            if tp:
+                for suffix, s in _STACKED_TP_RULES:
+                    if names[-len(suffix):] == suffix:
+                        return s
             return P("pp")
+        if tp and names[-2:] == ("lm_head", "kernel"):
+            return P(None, "tp")          # vocab-sharded head
         return P()
     return jax.tree_util.tree_map_with_path(spec, pparams)
 
 
 def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
-                       dp_axis="dp", pp_axis="pp"):
-    """Build a jitted dp × pp training step for TransformerLM.
+                       dp_axis="dp", pp_axis="pp", tp_axis="tp"):
+    """Build a jitted dp × pp (× tp) training step for TransformerLM.
 
     The layer stack is split over ``pp_axis`` (layers_per_stage =
     num_layers / pp); the batch over ``dp_axis``; microbatches flow through
@@ -130,6 +157,14 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
     everything (the DistributedOptimizer role, done explicitly here because
     replicated-vs-stacked params need different pp treatment), plus pp-sum
     for the replicated embed/head/norm params, which only one stage touches.
+
+    Tensor parallelism composes automatically: when the mesh carries a
+    ``tp_axis`` with more than one way, the pipeline's shard_map is
+    manual over (dp, pp) ONLY — 'tp' stays a GSPMD axis, the returned
+    shardings place the stacked kernels Megatron-style
+    (pipeline_param_specs(tp=True)), and XLA inserts the tp all-reduces
+    inside each stage. Manual code never mentions tp, so the same step
+    serves dp×pp and dp×pp×tp meshes.
 
     Args: ``pparams`` is the stacked layout from ``stack_pipeline_params``
     (used for shape/spec inference — pass the actual params or shapes).
@@ -216,18 +251,25 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
         pparams = optax.apply_updates(pparams, updates)
         return pparams, opt_state, lax.pmean(loss, dp_axis)
 
+    tp = mesh.shape.get(tp_axis, 1)
+    # shard_map is manual over (dp, pp) only; its specs must not name
+    # the GSPMD axes, so the manual tree stays pp-only even when tp > 1
     param_specs_tree = pipeline_param_specs(pparams)
     opt_specs = trainer_mod.opt_state_specs(tx, pparams, param_specs_tree)
     batch_spec = P(dp_axis, None)
     fn = jax.jit(jax.shard_map(
-        step, mesh=mesh,
+        step, mesh=mesh, axis_names=frozenset({dp_axis, pp_axis}),
         in_specs=(param_specs_tree, opt_specs, batch_spec),
         out_specs=(param_specs_tree, opt_specs, P())))
+
+    # placement shardings DO carry tp: GSPMD propagates them through the
+    # manual region and inserts the Megatron collectives
+    place_specs = pipeline_param_specs(pparams, tp=tp > 1)
 
     def shardings(spec_tree):
         return jax.tree_util.tree_map(
             lambda s: jax.sharding.NamedSharding(mesh, s), spec_tree,
             is_leaf=lambda s: isinstance(s, P))
 
-    return fn, shardings(param_specs_tree), \
+    return fn, shardings(place_specs), \
         jax.sharding.NamedSharding(mesh, batch_spec)

@@ -26,13 +26,25 @@ from .ops.compression import Compression
 
 def softmax_cross_entropy(logits, labels, weights=None):
     """Mean token-level cross entropy (labels are int ids). ``weights``
-    (same shape as labels) masks positions out of the mean."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    (same shape as labels) masks positions out of the mean.
+
+    Streaming-logsumexp form: ``nll = lse(logits) - logits[label]``.
+    Unlike ``log_softmax + gather`` it never materializes a
+    [..., vocab] log-prob array — the exp/sum fuses into one fp32
+    -accumulating pass over the logits in whatever dtype they arrive
+    (at GPT-2-small bench scale the logp buffer alone is 1.65 GB of
+    HBM write+read, ~2 ms/step on v5e). The max is stop_gradient'd:
+    its subtraction cancels in the gradient, and detaching it keeps
+    autodiff from emitting an argmax scatter."""
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    sumexp = jnp.sum(jnp.exp((logits - m).astype(jnp.float32)), axis=-1)
+    lse = m[..., 0].astype(jnp.float32) + jnp.log(sumexp)
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - tgt.astype(jnp.float32)
     if weights is None:
-        return -jnp.mean(ll)
-    weights = weights.astype(ll.dtype)
-    return -jnp.sum(ll * weights) / jnp.sum(weights)
+        return jnp.mean(nll)
+    weights = weights.astype(nll.dtype)
+    return jnp.sum(nll * weights) / jnp.sum(weights)
 
 
 def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,

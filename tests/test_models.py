@@ -166,16 +166,22 @@ class TestTiedEmbeddings:
         assert "lm_head" not in params
         logits = model.apply({"params": params}, toks)
         assert logits.shape == (2, 16, cfg.vocab_size)
-        # logits really are hidden @ embedding.T
+        # logits really are hidden @ embedding.T (fp32 straight from the
+        # MXU accumulator — models/transformer.py head path)
         hidden = model.apply({"params": params}, toks, return_hidden=True)
-        want = hidden @ params["embed"]["embedding"].T.astype(hidden.dtype)
+        want = jnp.dot(hidden,
+                       params["embed"]["embedding"].T.astype(hidden.dtype),
+                       preferred_element_type=jnp.float32)
         np.testing.assert_allclose(np.asarray(logits),
                                    np.asarray(want, np.float32),
                                    rtol=1e-5, atol=1e-5)
         dense = tr.lm_loss_fn(model)(params, toks)
         chunked = tr.lm_loss_fn(model, vocab_chunk=64)(params, toks)
+        # dense (streaming-lse over fp32 logits) and chunked (per-chunk
+        # online lse) accumulate in different orders — bit-exactness is
+        # not part of the contract
         np.testing.assert_allclose(float(dense), float(chunked),
-                                   rtol=1e-5)
+                                   rtol=1e-4)
         g = jax.grad(tr.lm_loss_fn(model))(params, toks)
         emb_g = np.asarray(g["embed"]["embedding"])
         assert np.isfinite(emb_g).all() and np.abs(emb_g).sum() > 0

@@ -188,3 +188,60 @@ class TestTransformerPipeline:
         pparams = pl.stack_pipeline_params(params, cfg.num_layers)
         with pytest.raises(ValueError, match="divisible"):
             pl.make_pipeline_step(cfg, optax.sgd(0.1), mesh, 2, pparams)
+
+
+class TestPipelineWithTensorParallel:
+    """The 3-axis composition (VERDICT r2 item 3): pipeline stages whose
+    kernels are ALSO Megatron-sharded over 'tp'. shard_map is manual over
+    (dp, pp) only, tp stays a GSPMD axis — numerics must match the
+    single-device model exactly, not just stay finite."""
+
+    def test_dp2_pp2_tp2_update_matches_unpipelined(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.parallel import mesh as mesh_mod
+        from horovod_tpu.parallel import pipeline as pl
+        from horovod_tpu import trainer
+
+        mesh = mesh_mod.build_mesh(dp=2, pp=2, tp=2)
+        cfg = tr.TransformerConfig.tiny(dtype=jnp.float32)  # 2 layers
+        model = tr.TransformerLM(cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 33)),
+            jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens[:, :-1])["params"]
+        pparams = pl.stack_pipeline_params(params, cfg.num_layers)
+        tx = optax.sgd(0.05)
+        step, pshard, bshard = pl.make_pipeline_step(
+            cfg, tx, mesh, num_microbatches=2, pparams=pparams)
+        # placement really is tp-sharded (not a silent all-replicated)
+        qkv_spec = pshard["layers"]["attn"]["qkv"]["kernel"].spec
+        assert "tp" in tuple(qkv_spec), qkv_spec
+        pparams = jax.tree_util.tree_map(jax.device_put, pparams, pshard)
+        opt_state = tx.init(pparams)
+        tokens_sharded = jax.device_put(tokens, bshard)
+
+        p1, _, loss = step(pparams, opt_state, tokens_sharded)
+
+        def loss_fn(p, toks):
+            logits = model.apply({"params": p}, toks[:, :-1])
+            return trainer.softmax_cross_entropy(logits, toks[:, 1:])
+
+        expect_loss = loss_fn(params, tokens)
+        np.testing.assert_allclose(float(loss), float(expect_loss),
+                                   rtol=1e-4)
+        g = jax.grad(loss_fn)(params, tokens)
+        updates, _ = tx.update(g, tx.init(params), params)
+        ref = pl.stack_pipeline_params(
+            optax.apply_updates(params, updates), cfg.num_layers)
+        for (ka, a), (kb, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(p1),
+                       key=lambda kv: str(kv[0])),
+                sorted(jax.tree_util.tree_leaves_with_path(ref),
+                       key=lambda kv: str(kv[0]))):
+            assert str(ka) == str(kb)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-5,
+                                       err_msg=str(ka))

@@ -1,0 +1,42 @@
+"""Capture a device trace of the flagship transformer step and print the
+op-level time breakdown (uses horovod_tpu.utils.profiling's summarizer).
+
+Usage: python tools/profile_step.py [--out /tmp/step_trace] [--steps 5]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/step_trace")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--chunk", type=int, default=0)
+    ap.add_argument("--remat", action="store_true")
+    args = ap.parse_args()
+
+    import horovod_tpu as hvd
+    hvd.init()
+    from step_ab import build  # noqa: E402  (same dir)
+
+    step, params, opt_state, toks = build(args.chunk, args.remat)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)
+
+    with jax.profiler.trace(args.out):
+        for _ in range(args.steps):
+            params, opt_state, loss = step(params, opt_state, toks)
+        float(loss)
+    print("trace written to", args.out)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

@@ -1,0 +1,86 @@
+"""A/B harness for flagship-transformer step-time experiments on the
+real chip: loss variants (full logits vs chunked CE), remat, etc.
+
+Usage: python tools/step_ab.py [--steps 20] [--windows 3]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def time_step(step, params, opt_state, toks, steps, windows):
+    params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)
+    times = []
+    for _ in range(windows + 1):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, toks)
+        float(loss)
+        times.append((time.perf_counter() - t0) / steps)
+    return float(np.min(times[1:])) * 1e3  # ms; first window warms cache
+
+
+def build(vocab_chunk, remat, batch=8, seq=1024):
+    import horovod_tpu as hvd
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu import trainer
+    from horovod_tpu.parallel import mesh as mesh_mod
+
+    cfg = tr.TransformerConfig.gpt2_small(
+        attention_impl="flash", tie_embeddings=True, remat=remat)
+    mesh = mesh_mod.build_mesh(dp=hvd.size())
+    model = tr.TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, seq), jnp.int32))["params"]
+    tx = optax.adamw(3e-4)
+    loss = tr.lm_loss_fn(model, vocab_chunk=vocab_chunk)
+    step, pshard, bshard = trainer.make_gspmd_step(
+        loss, tx, mesh, tr.param_specs(params), tr.batch_spec(),
+        params=params)
+    params = jax.tree_util.tree_map(jax.device_put, params, pshard)
+    opt_state = trainer.init_opt_state(tx, params, mesh,
+                                       tr.param_specs(params))
+    rng = np.random.RandomState(0)
+    toks = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq),
+                                dtype=np.int64).astype(np.int32)), bshard)
+    return step, params, opt_state, toks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--windows", type=int, default=2)
+    ap.add_argument("--variants", type=str,
+                    default="chunk0,chunk8192,chunk16384,chunk25152")
+    args = ap.parse_args()
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    for name in args.variants.split(","):
+        remat = "remat" in name
+        chunk = int(name.replace("chunk", "").replace("remat", "") or 0)
+        step, params, opt_state, toks = build(chunk, remat)
+        ms = time_step(step, params, opt_state, toks, args.steps,
+                       args.windows)
+        tok_s = 8 * 1024 / (ms / 1e3)
+        print(f"{name:<16} {ms:8.2f} ms/step  {tok_s:9.0f} tok/s")
+        step = params = opt_state = toks = None
+        jax.clear_caches()
+
+
+if __name__ == "__main__":
+    main()
