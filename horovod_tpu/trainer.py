@@ -116,18 +116,11 @@ def init_opt_state(tx, params, mesh, param_spec_tree=None):
     return jax.jit(tx.init, out_shardings=shardings)(params)
 
 
-def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
-                    donate=True, params=None):
-    """Sharding-annotated train step: params placed by ``param_spec_tree``
-    (e.g. models.transformer.param_specs), batch by ``batch_spec``; XLA
-    (GSPMD) inserts all tp/sp/dp collectives over ICI.
-
-    Pass ``params`` (the concrete or abstract param tree) so the optimizer
-    state's shardings can be derived too and every step argument/result is
-    pinned — without it, ``tx.init`` on the host yields SingleDeviceSharding
-    scalars whose shardings change after the first step, costing a silent
-    second compilation of the whole step.
-    """
+def _gspmd_shardings(tx, mesh, param_spec_tree, batch_spec, params):
+    """Shared sharding derivation for make_gspmd_step /
+    make_gspmd_multi_step: (param, opt, batch, out) NamedShardings.
+    opt/out are None when ``params`` is not given (see the callers'
+    docstrings for why passing it matters)."""
 
     def to_sharding(spec):
         return NamedSharding(mesh, spec)
@@ -142,6 +135,23 @@ def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
     else:
         opt_shardings = None
         out_shardings = None
+    return param_shardings, opt_shardings, batch_sharding, out_shardings
+
+
+def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
+                    donate=True, params=None):
+    """Sharding-annotated train step: params placed by ``param_spec_tree``
+    (e.g. models.transformer.param_specs), batch by ``batch_spec``; XLA
+    (GSPMD) inserts all tp/sp/dp collectives over ICI.
+
+    Pass ``params`` (the concrete or abstract param tree) so the optimizer
+    state's shardings can be derived too and every step argument/result is
+    pinned — without it, ``tx.init`` on the host yields SingleDeviceSharding
+    scalars whose shardings change after the first step, costing a silent
+    second compilation of the whole step.
+    """
+    param_shardings, opt_shardings, batch_sharding, out_shardings = \
+        _gspmd_shardings(tx, mesh, param_spec_tree, batch_spec, params)
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -152,6 +162,48 @@ def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(
         step,
+        in_shardings=(param_shardings, opt_shardings, batch_sharding),
+        out_shardings=out_shardings,
+        donate_argnums=donate_argnums), param_shardings, batch_sharding
+
+
+def make_gspmd_multi_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
+                          donate=True, params=None):
+    """Device-side training loop: like make_gspmd_step but the returned
+    function runs ``lax.scan`` over a STACKED batch ``[n_steps, ...]``
+    and returns the last step's loss — n_steps optimizer updates per
+    host dispatch.
+
+    Why: each host->device dispatch of a jitted step costs a few ms on
+    remote-attached runtimes (measured ~3-5 ms/step on the tunneled v5e
+    — a whole percent of MFU at GPT-2 scale). Scanning on device
+    amortizes that to ~zero; the standard JAX training-loop idiom for
+    small-step/large-count regimes. The per-step ``step`` from
+    make_gspmd_step remains the right tool when the host needs the loss
+    every step (callbacks, logging, elastic checkpoints).
+
+    The stacked batch shards as P(None, *batch_spec) — the leading
+    step axis is never split across devices.
+    """
+    param_shardings, opt_shardings, batch_sharding, out_shardings = \
+        _gspmd_shardings(tx, mesh, param_spec_tree, P(None, *batch_spec),
+                         params)
+
+    def multi_step(params, opt_state, batches):
+        def body(carry, batch):
+            p, o = carry
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            updates, o = tx.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), batches)
+        return params, opt_state, losses[-1]
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(
+        multi_step,
         in_shardings=(param_shardings, opt_shardings, batch_sharding),
         out_shardings=out_shardings,
         donate_argnums=donate_argnums), param_shardings, batch_sharding
