@@ -51,13 +51,10 @@ def measure(n_tensors, elems, iters):
     nbytes = sum(t.nbytes for t in tensors)
     rates = []
     for it in range(iters + 1):
-        coord._paused = True  # hold the cycle so the burst lands together
-        try:
+        with coord.hold_cycle():  # the burst lands in one fused cycle
             handles = [hvd.allreduce_async(t, average=False,
                                            name=f"ar.{it}.{i}")
                        for i, t in enumerate(tensors)]
-        finally:
-            coord._paused = False
         t0 = time.perf_counter()
         coord.flush()
         outs = [hvd.synchronize(h) for h in handles]

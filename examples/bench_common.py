@@ -16,9 +16,12 @@ import horovod_tpu as hvd
 from horovod_tpu import models, trainer
 
 
-def build_step(model_name, mesh, batch, image_size, fp16_allreduce=False):
+def build_step(model_name, mesh, batch, image_size, fp16_allreduce=False,
+               steps_per_call=1):
     """Compiled data-parallel train step + initial (params, opt_state,
-    batch data) for a zoo model on synthetic ImageNet-shaped data."""
+    batch data) for a zoo model on synthetic ImageNet-shaped data.
+    ``steps_per_call`` runs that many updates on-device per host call
+    (trainer.make_data_parallel_step) — the synthetic-loop form."""
     kwargs = {"dropout_rate": 0.0} if model_name.startswith("vgg") else {}
     model = models.build(model_name, num_classes=1000, dtype=jnp.bfloat16,
                          **kwargs)
@@ -43,7 +46,8 @@ def build_step(model_name, mesh, batch, image_size, fp16_allreduce=False):
 
     step = trainer.make_data_parallel_step(loss_fn, tx, mesh,
                                            compression=compression,
-                                           donate=True)
+                                           donate=True,
+                                           steps_per_call=steps_per_call)
     sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
     images = jax.device_put(images, sharding)
     labels = jax.device_put(labels, sharding)
@@ -52,7 +56,7 @@ def build_step(model_name, mesh, batch, image_size, fp16_allreduce=False):
 
 def timed_rates(step, params, opt_state, batch_data, batch,
                 num_warmup_batches, num_iters, num_batches_per_iter,
-                on_iter=None):
+                on_iter=None, updates_per_step=1):
     """Run the reference timing protocol; returns per-iteration total
     img/sec. At least one warmup step always runs so trace+compile of the
     jitted step can never land inside the timed region (a compile-polluted
@@ -71,7 +75,7 @@ def timed_rates(step, params, opt_state, batch_data, batch,
             params, opt_state, loss = step(params, opt_state, batch_data)
         float(loss)  # scalar transfer: a sync barrier on every backend
         dt = time.perf_counter() - t0
-        rate = batch * num_batches_per_iter / dt
+        rate = batch * num_batches_per_iter * updates_per_step / dt
         rates.append(rate)
         if on_iter is not None:
             on_iter(i, rate)

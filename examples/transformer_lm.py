@@ -41,6 +41,7 @@ from horovod_tpu.utils import checkpoint
 
 SIZES = {"tiny": tr.TransformerConfig.tiny,
          "gpt2-small": tr.TransformerConfig.gpt2_small,
+         "gpt2-small-tpu": tr.TransformerConfig.gpt2_small_tpu,
          "llama-1b": tr.TransformerConfig.llama_1b}
 
 

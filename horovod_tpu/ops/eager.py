@@ -40,6 +40,7 @@ Eager input conventions (single-controller SPMD):
 """
 
 import collections
+import contextlib
 import functools
 import threading
 import time
@@ -317,6 +318,20 @@ class EagerCoordinator:
     def poll(self, handle):
         return self.handles.poll(handle)
 
+    @contextlib.contextmanager
+    def hold_cycle(self):
+        """Public burst hook: while held, no cycle runs (background loop
+        and synchronize-side flushes pause), so every collective enqueued
+        inside lands in ONE fused cycle on the next flush. What a
+        backward pass's dispatch order gives training steps naturally,
+        benchmarks get explicitly (examples/allreduce_benchmark.py,
+        bench.py's autotune leg)."""
+        self._paused = True
+        try:
+            yield
+        finally:
+            self._paused = False
+
     def synchronize(self, handle):
         """Block until the handle's collective completes and return its
         output (torch/mpi_ops.py:422-438)."""
@@ -394,6 +409,7 @@ class EagerCoordinator:
         # under the old plan and paid the sync-allgather latency, so it
         # belongs to neither knob setting
         if (self.autotuner is not None
+                and not self.autotuner.frozen
                 and not self._autotune_pending_adoption
                 and not self._adopted_this_flush):
             # JAX dispatch is async: without blocking, t1-t0 measures
@@ -855,6 +871,28 @@ class EagerCoordinator:
                 self._sync_tuned_params()
             if tl:
                 tl.end_activity(entry.name)
+
+    def freeze_autotune(self):
+        """End the tuning phase: adopt the best scored point into the
+        live config and stop per-cycle scoring (the reference
+        ParameterManager's converged state). Single/multi-process safe:
+        on the deferred (multi-process) path the adopted values still
+        travel through the next agreed _sync_tuned_params point rather
+        than being applied locally mid-stream. Returns the adopted
+        (threshold, cycle_ms, score) or None."""
+        if self.autotuner is None:
+            return None
+        best = self.autotuner.freeze()
+        if best is None:
+            return None
+        if self._autotune_defer:
+            self._proposed_params = (self.autotuner.threshold,
+                                     self.autotuner.cycle_time_ms)
+            self._autotune_pending_adoption = True
+        else:
+            self._config.fusion_threshold = int(self.autotuner.threshold)
+            self._config.cycle_time_ms = float(self.autotuner.cycle_time_ms)
+        return best
 
     def _sync_tuned_params(self):
         """Adopt process 0's (possibly staged) tuned parameters on every

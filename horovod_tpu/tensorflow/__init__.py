@@ -375,9 +375,12 @@ def DistributedOptimizer(optimizer, compression=Compression.none):
     AsyncOpKernels (tensorflow/mpi_ops.cc:276-304); see
     _graph_fused_allreduce. The single host call also keeps the
     collective order identical on all workers regardless of TF's graph
-    scheduling. py_function cannot be lowered by XLA: pass
-    ``jit_compile=False`` to ``model.compile`` on hosts with accelerators
-    (Keras auto-enables XLA there).
+    scheduling. Measured seam cost: ~1 ms/step flat
+    (tools/tf_pyfunc_bench.py; docs/migration.md has the table).
+    ``jit_compile=True`` works — XLA auto-clustering compiles the model
+    around the py_function, which runs between clusters — but plain
+    ``tf.function`` measured faster on CPU (clustering fragments the
+    step); prefer the default.
 
     Keras-on-JAX note: the JAX trainer applies gradients via
     ``stateless_apply`` inside jit and never calls ``apply_gradients``, so

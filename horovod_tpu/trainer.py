@@ -50,7 +50,7 @@ def softmax_cross_entropy(logits, labels, weights=None):
 def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
                             compression=Compression.none,
                             fusion_threshold=None, donate=True,
-                            batch_specs=None):
+                            batch_specs=None, steps_per_call=1):
     """Compiled Horovod-style train step.
 
     ``loss_fn(params, batch) -> scalar`` is the per-worker loss on the
@@ -58,10 +58,19 @@ def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
     opt_state, mean_loss)`` where batch's leading dim is sharded over the
     worker axis and gradients are averaged with one fused psum per fusion
     bucket before the optimizer applies them.
+
+    ``steps_per_call > 1`` runs that many optimizer updates on-device
+    per host call (lax.fori_loop), re-using the SAME batch each inner
+    step — the synthetic-benchmark loop (the reference harness feeds one
+    fixed batch repeatedly; examples/synthetic_benchmark.py). Host
+    dispatch of a ResNet-scale step graph (~3,400 ops) costs many ms on
+    remote-attached runtimes, so amortizing it matters at small batch.
+    For real training with distinct batches use steps_per_call=1 or
+    make_gspmd_multi_step (which scans over stacked batches).
     """
     axis = axis_name or mesh.axis_names[0]
 
-    def per_worker(params, opt_state, batch):
+    def one_update(params, opt_state, batch):
         # Backward pass on a device-varying copy of the params — see
         # ops.collective_ops.ensure_varying for why (replicated params
         # would make autodiff pre-sum the grads, turning the explicit
@@ -77,6 +86,20 @@ def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
         params = optax.apply_updates(params, updates)
         mean_loss = jax.lax.pmean(loss, axis)
         return params, opt_state, mean_loss
+
+    def per_worker(params, opt_state, batch):
+        if steps_per_call == 1:
+            return one_update(params, opt_state, batch)
+
+        def body(_, carry):
+            p, o, _loss = carry
+            p, o, loss = one_update(p, o, batch)
+            # the carry's loss slot is fp32 regardless of loss_fn's
+            # dtype (a bf16 loss would trip fori_loop's carry check)
+            return p, o, loss.astype(jnp.float32)
+
+        init = (params, opt_state, jnp.float32(0))
+        return jax.lax.fori_loop(0, steps_per_call, body, init)
 
     # batch_specs: PartitionSpec pytree for the batch argument (per-leaf),
     # default: shard every leaf's leading dim over the worker axis.

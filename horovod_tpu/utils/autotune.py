@@ -105,6 +105,7 @@ class Autotuner:
     def __init__(self, config, log_path=None, seed=0):
         self.threshold = float(config.fusion_threshold)
         self.cycle_time_ms = float(config.cycle_time_ms)
+        self.frozen = False
         self._engine = (_NativeEngine(seed) if _native.available()
                         else _PythonEngine(seed))
         self._cycle_bytes = 0
@@ -116,6 +117,8 @@ class Autotuner:
             self._log.write("threshold_bytes,cycle_time_ms,score_bytes_per_us\n")
 
     def record_cycle(self, total_bytes, duration_s):
+        if self.frozen:
+            return False
         self._cycle_bytes += int(total_bytes)
         self._cycle_time += float(duration_s)
         self._cycles += 1
@@ -141,6 +144,20 @@ class Autotuner:
 
     def best(self):
         return self._engine.best()
+
+    def freeze(self):
+        """Stop tuning and adopt the best scored point (the reference
+        ParameterManager's end state once Tune() stops improving:
+        parameter_manager.cc:155-210 sets active_=false and runs at the
+        best values). After this, record_cycle becomes a no-op — the
+        coordinator stops paying the per-cycle device sync that exact
+        scoring requires. Returns (threshold, cycle_ms, score) or None
+        if nothing was ever scored."""
+        self.frozen = True
+        b = self._engine.best()
+        if b is not None:
+            self.threshold, self.cycle_time_ms = b[0], b[1]
+        return b
 
     def close(self):
         if self._log:

@@ -116,3 +116,48 @@ class TestSyncUnit:
         before = (cfg.fusion_threshold, cfg.cycle_time_ms)
         coord._sync_tuned_params()
         assert (cfg.fusion_threshold, cfg.cycle_time_ms) == before
+
+
+class TestFreeze:
+    def test_freeze_adopts_best_and_stops_scoring(self, hvd):
+        """Autotuner.freeze: the reference ParameterManager's converged
+        state (tune, then run at the best values with scoring off,
+        parameter_manager.cc:155-210). After freeze, record_cycle is a
+        no-op and the knobs hold the best scored point."""
+        from horovod_tpu.common.config import HorovodConfig
+        from horovod_tpu.utils import autotune as at
+
+        cfg = HorovodConfig.from_env()
+        tuner = at.Autotuner(cfg, seed=1)
+        # score two points directly through the engine, then freeze
+        tuner._engine.record(1 << 20, 5.0, 10.0)
+        tuner._engine.record(8 << 20, 7.0, 50.0)
+        best = tuner.freeze()
+        assert best is not None
+        assert (tuner.threshold, tuner.cycle_time_ms) == (best[0], best[1])
+        assert best[2] == 50.0 and tuner.threshold == 8 << 20
+        # scoring is off: many cycles never advance the knobs
+        for _ in range(200):
+            assert tuner.record_cycle(1 << 20, 0.001) is False
+        assert tuner.threshold == 8 << 20
+
+    def test_coordinator_freeze_applies_config(self, hvd):
+        import horovod_tpu
+        from horovod_tpu.utils import autotune as at
+
+        state = horovod_tpu.common.state.global_state()
+        coord = state.coordinator
+        cfg = state.config
+        saved = (cfg.fusion_threshold, cfg.cycle_time_ms,
+                 coord.autotuner, coord._autotune_defer)
+        try:
+            coord.autotuner = at.Autotuner(cfg, seed=2)
+            coord._autotune_defer = False
+            coord.autotuner._engine.record(4 << 20, 9.0, 42.0)
+            best = coord.freeze_autotune()
+            assert best is not None
+            assert cfg.fusion_threshold == 4 << 20
+            assert cfg.cycle_time_ms == 9.0
+        finally:
+            (cfg.fusion_threshold, cfg.cycle_time_ms,
+             coord.autotuner, coord._autotune_defer) = saved
