@@ -52,7 +52,7 @@ def _peak_flops(device):
     return best[1] if best else None
 
 
-def _bench_autotune(hvd, on_tpu, n_tensors=16, kb=256):
+def _bench_autotune(hvd, n_tensors=16, kb=256):
     """Score the autotuner on the chip (judge r2 item 6): eager fused
     allreduce bytes/us with defaults vs with HOROVOD_AUTOTUNE=1 after
     its GP/EI exploration, plus the adopted threshold/cycle-time.
@@ -60,8 +60,6 @@ def _bench_autotune(hvd, on_tpu, n_tensors=16, kb=256):
     the knobs being tuned are the real per-cycle bucketing/dispatch
     costs. Re-inits the library (autotune config is read at init)."""
     import time
-
-    import numpy as np
 
     import horovod_tpu.common.state as state
     from horovod_tpu.utils import autotune as autotune_mod
@@ -91,7 +89,7 @@ def _bench_autotune(hvd, on_tpu, n_tensors=16, kb=256):
                 rates.append(nbytes / dt / 1e6)
         return float(np.median(rates))
 
-    measure = 10
+    measure = 7
     # both legs must run against a KNOWN autotune state regardless of
     # the caller's env: force it off for the default leg, on for the
     # tuned leg, and restore the caller's setting afterwards
@@ -99,35 +97,54 @@ def _bench_autotune(hvd, on_tpu, n_tensors=16, kb=256):
     if prior is not None:
         hvd.shutdown()
         hvd.init()
-    default_rate = burst_rate("off", 13, measure)
+    default_rate = burst_rate("off", 9, measure)
 
     hvd.shutdown()
     os.environ["HOROVOD_AUTOTUNE"] = "1"
+    # Bench-scale exploration budget. A scored GP point normally costs
+    # CYCLES_PER_SAMPLE * SAMPLES_PER_STEP (= 50) flush cycles; through
+    # the tunneled runtime every NEW fusion plan also recompiles its
+    # stacked collective, so the production budget would take many
+    # minutes — shrink the windows. Cycle-time exploration is also
+    # capped at 30 ms here: while scoring is ON every cycle pays a
+    # blocking device sync the frozen phase doesn't, and that overhead
+    # makes very long cycles score well in exploration yet lose after
+    # freeze (regime mismatch). Production runs keep the defaults.
+    saved = (autotune_mod.CYCLES_PER_SAMPLE,
+             autotune_mod.SAMPLES_PER_STEP,
+             autotune_mod.CYCLE_BOUNDS_MS)
     try:
-        hvd.init()
-        coord = state.global_state().coordinator
-        tuner = coord.autotuner
-        # A scored GP point normally costs CYCLES_PER_SAMPLE *
-        # SAMPLES_PER_STEP (= 50) flush cycles. Through the tunneled
-        # runtime every NEW fusion plan also recompiles its stacked
-        # collective, so the full production budget would take many
-        # minutes here — shrink the per-point budget for this
-        # bench-scale score (production runs keep the defaults).
-        saved = (autotune_mod.CYCLES_PER_SAMPLE,
-                 autotune_mod.SAMPLES_PER_STEP)
-        autotune_mod.CYCLES_PER_SAMPLE = 3
-        autotune_mod.SAMPLES_PER_STEP = 3
         try:
-            points = 6
+            autotune_mod.CYCLES_PER_SAMPLE = 3
+            autotune_mod.SAMPLES_PER_STEP = 3
+            autotune_mod.CYCLE_BOUNDS_MS = (1.0, 30.0)
+            hvd.init()  # the tuner's engine captures the bounds here
+            coord = state.global_state().coordinator
+            tuner = coord.autotuner
+            points = 5
             burst_rate("explore", points * 9, 1)
         finally:
             (autotune_mod.CYCLES_PER_SAMPLE,
-             autotune_mod.SAMPLES_PER_STEP) = saved
+             autotune_mod.SAMPLES_PER_STEP,
+             autotune_mod.CYCLE_BOUNDS_MS) = saved
         # converge: adopt the best point and stop scoring — the frozen
         # phase no longer pays the per-cycle device sync that exact
         # scoring requires (coordinator.freeze_autotune)
         best = coord.freeze_autotune()
-        tuned_rate = burst_rate("on", 13, measure)
+        tuned_rate = burst_rate("on", 9, measure)
+        # validate like the reference's ParameterManager (tuned values
+        # are only kept when they beat the baseline): the bench-scale
+        # 3x3 scoring windows are noisy enough that the GP occasionally
+        # crowns a bad point — measure it, and fall back to the
+        # defaults if it lost
+        kept = tuned_rate >= default_rate
+        if not kept:
+            # revert the LIVE knobs: freeze_autotune wrote the adopted
+            # point into the coordinator's config, which is what the
+            # fusion planner actually reads
+            cfg = state.global_state().config
+            cfg.fusion_threshold = 64 << 20
+            cfg.cycle_time_ms = 5.0
     finally:
         if prior is None:
             os.environ.pop("HOROVOD_AUTOTUNE", None)
@@ -141,6 +158,7 @@ def _bench_autotune(hvd, on_tpu, n_tensors=16, kb=256):
         "tuned_bytes_per_us": round(tuned_rate, 2),
         "gain_pct": round((tuned_rate / default_rate - 1) * 100, 1),
         "burst": f"{n_tensors}x{kb}KB",
+        "kept": kept,  # False = tuned point lost validation, reverted
     }
     if best is not None:
         out["adopted_threshold_mb"] = round(best[0] / 2**20, 2)
@@ -209,7 +227,7 @@ def main():
         tlm = {"error": str(e)[:200]}
 
     try:
-        autotune = _bench_autotune(hvd, on_tpu)
+        autotune = _bench_autotune(hvd)
     except Exception as e:  # noqa: BLE001 — headline metrics still print
         print(f"autotune bench failed: {e}", file=sys.stderr)
         autotune = {"error": str(e)[:200]}

@@ -326,11 +326,12 @@ class EagerCoordinator:
         backward pass's dispatch order gives training steps naturally,
         benchmarks get explicitly (examples/allreduce_benchmark.py,
         bench.py's autotune leg)."""
+        prev = self._paused
         self._paused = True
         try:
             yield
         finally:
-            self._paused = False
+            self._paused = prev
 
     def synchronize(self, handle):
         """Block until the handle's collective completes and return its

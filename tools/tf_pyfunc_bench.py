@@ -105,8 +105,6 @@ def main():
     # tiny dense model: the seam's flat cost is visible at this scale
     import keras
 
-    import horovod_tpu.tensorflow as tfhvd
-
     def tiny(hvd_wrap):
         model = keras.Sequential([
             keras.layers.Input((32,)),
@@ -127,12 +125,7 @@ def main():
     for name, wrap in (("tiny dense, no hvd", False),
                        ("tiny dense + hvd", True)):
         m = tiny(wrap)
-        m.fit(x2[:batch2], y2[:batch2], batch_size=batch2, epochs=1,
-              verbose=0)
-        t0 = time.perf_counter()
-        m.fit(x2, y2, batch_size=batch2, epochs=1, verbose=0,
-              shuffle=False)
-        ms = (time.perf_counter() - t0) / steps2 * 1e3
+        ms = time_fit(m, x2, y2, batch2, steps2)
         tiny_rows.append(ms)
         print(f"{name:<34} {ms:7.3f} ms/step")
 
