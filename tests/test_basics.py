@@ -90,3 +90,24 @@ class TestMpirunCompat:
         with pytest.raises(RuntimeError, match="stop before"):
             mpi_ops.init()
         assert seen == {"addr": "127.0.0.1:43210", "n": 4, "pid": 3}
+
+
+def test_hold_cycle_nests_and_restores(hvd):
+    """coordinator.hold_cycle(): burst collectives land in one fused
+    cycle; nested holds must not release the outer hold early, and the
+    prior paused state is restored on exit."""
+    import numpy as np
+    import horovod_tpu
+    coord = horovod_tpu.common.state.global_state().coordinator
+    assert coord._paused is False
+    with coord.hold_cycle():
+        assert coord._paused is True
+        with coord.hold_cycle():
+            assert coord._paused is True
+        # inner exit must NOT release the outer hold
+        assert coord._paused is True
+        h = hvd.allreduce_async(np.ones(4, np.float32), average=False,
+                                name="hold.t")
+    assert coord._paused is False
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), np.ones(4))

@@ -38,8 +38,10 @@ def build(vocab_chunk, remat, batch=8, seq=1024):
     from horovod_tpu import trainer
     from horovod_tpu.parallel import mesh as mesh_mod
 
-    cfg = tr.TransformerConfig.gpt2_small(
-        attention_impl="flash", tie_embeddings=True, remat=remat)
+    import dataclasses
+
+    from bench_common import flagship_config
+    cfg = dataclasses.replace(flagship_config(True), remat=remat)
     mesh = mesh_mod.build_mesh(dp=hvd.size())
     model = tr.TransformerLM(cfg)
     params = model.init(jax.random.PRNGKey(0),
