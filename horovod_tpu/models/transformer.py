@@ -444,6 +444,19 @@ def lm_loss_fn(model, aux_weight=0.01, vocab_chunk=0):
     return loss_fn
 
 
+def matmul_flops_per_token(cfg, seq):
+    """Matmul FLOPs per token, PaLM appendix-B convention:
+    ``6·P_matmul + 12·L·seq·d_model``. P_matmul counts qkv+out
+    projections (4·d²), the gated SwiGLU MLP (THREE d×d_ff kernels:
+    gate/up/down — MLP above), and the lm_head. Head-count independent,
+    so MFU numbers are comparable across head shapes (gpt2_small vs
+    gpt2_small_tpu)."""
+    p_matmul = (cfg.num_layers * (4 * cfg.d_model ** 2 +
+                                  3 * cfg.d_model * cfg.d_ff) +
+                cfg.d_model * cfg.vocab_size)
+    return 6 * p_matmul + 12 * cfg.num_layers * seq * cfg.d_model
+
+
 def init_params(cfg, rng, batch_size=2, seq_len=None):
     model = TransformerLM(cfg)
     seq_len = seq_len or min(cfg.max_seq_len, 128)

@@ -211,15 +211,5 @@ class TestTpuHeadShape:
                (b.d_model, b.num_layers, b.d_ff, b.vocab_size)
         assert b.d_model // b.num_heads == 128  # the lane width
 
-        # import the shared FLOP formula without leaving examples/ on
-        # sys.path (its generic module names would shadow later imports)
-        import importlib.util
-        import os
-        spec = importlib.util.spec_from_file_location(
-            "_bench_common_flops", os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                "examples", "bench_common.py"))
-        bc = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(bc)
-        assert (bc.transformer_matmul_flops_per_token(a, 1024) ==
-                bc.transformer_matmul_flops_per_token(b, 1024))
+        assert (tr.matmul_flops_per_token(a, 1024) ==
+                tr.matmul_flops_per_token(b, 1024))
