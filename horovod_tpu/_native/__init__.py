@@ -99,6 +99,28 @@ def build(force=False):
     return _LIB_PATH
 
 
+_TF_LIB_PATH = os.path.join(_DIR, "libhvd_tf.so")
+
+
+def build_tf(force=False):
+    """Compile the native TensorFlow custom ops (libhvd_tf.so) against the
+    installed TF's headers (tf.sysconfig — the reference builds its TF
+    extension the same way, setup.py build_tf_extension). Raises if
+    TensorFlow is not importable; callers treat that as 'unavailable'."""
+    import tensorflow as tf  # deferred: TF is an optional frontend dep
+
+    src = os.path.join(_DIR, "src", "tf_ops.cc")
+    if not force and os.path.exists(_TF_LIB_PATH):
+        if os.path.getmtime(_TF_LIB_PATH) >= os.path.getmtime(src):
+            return _TF_LIB_PATH
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o",
+            _TF_LIB_PATH, src]
+           + tf.sysconfig.get_compile_flags()
+           + tf.sysconfig.get_link_flags())
+    subprocess.run(cmd, check=True)
+    return _TF_LIB_PATH
+
+
 def load(auto_build=True):
     """Load (building if needed) the native core; returns the lib or None.
     A failed build/load is cached so the hot path never re-spawns g++."""

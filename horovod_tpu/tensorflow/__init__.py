@@ -5,10 +5,13 @@ TPU-native equivalent of the reference TF frontend
 collectives on eager tf.Tensors bridged through the shared eager
 coordination core (one TF replica per host process), plus the training
 integration surface — ``DistributedOptimizer`` wrapping a Keras optimizer,
-``DistributedGradientTape``, and ``broadcast_variables``. The reference's
-AsyncOpKernel C++ custom ops (tensorflow/mpi_ops.cc:276-463) are
-TPU-unnecessary: TF2 eager tensors expose their buffer without a custom
-kernel, and async handles map onto the core's handle table.
+``DistributedGradientTape``, and ``broadcast_variables``. Inside compiled
+``tf.function`` steps, gradients fuse in-graph and reduce through REAL
+native AsyncOpKernel custom ops when libhvd_tf.so is built
+(tensorflow/native.py, _native/src/tf_ops.cc — the role of the
+reference's tensorflow/mpi_ops.cc:276-463), falling back to one fused
+``tf.py_function`` per step otherwise; eager tensors ride the core's
+async handle table directly.
 
     import horovod_tpu.tensorflow as hvd
     hvd.init()
@@ -22,8 +25,15 @@ from .. import mpi_ops as _core
 from ..common.exceptions import NotInitializedError  # noqa: F401
 
 init = _core.init
-shutdown = _core.shutdown
 is_initialized = _core.is_initialized
+
+
+def shutdown():
+    """Shut down the core (and the native TF comm plane, when it was
+    brought up by a compiled-graph collective)."""
+    from . import native
+    native.shutdown_plane()
+    _core.shutdown()
 # TF workers are host processes, one replica each — process-level identity,
 # like the torch frontend (reference one-rank-per-process, run/run.py).
 size = _core.process_count
@@ -42,6 +52,18 @@ from ..ops.compression import Compression  # noqa: F401
 
 # handle -> tf dtype for result conversion
 _handle_map = {}
+
+def _fusion_tag(items):
+    """Stable tag distinguishing collective call sites in wire names.
+    Derived from variable/tensor names (the reference keys its ops off
+    names too, tensorflow/__init__.py:55-60): globally uniquified per
+    process, so two optimizers' fused buffers cannot collide; identical
+    across ranks (same program); and — unlike a per-trace counter —
+    stable when one rank retraces a tf.function the others kept cached."""
+    import hashlib
+    names = "|".join(str(getattr(t, "name", t.__class__.__name__) or "")
+                     for t in items)
+    return hashlib.md5(names.encode()).hexdigest()[:8]
 
 
 def _to_numpy(tensor):
@@ -255,17 +277,40 @@ class DistributedGradientTape:
         return getattr(self._tape, item)
 
     def gradient(self, target, sources, output_gradients=None):
+        import tensorflow as tf
         grads = self._tape.gradient(target, sources,
                                     output_gradients=output_gradients)
         if size() == 1:
             return grads
         flat, structure = _flatten(grads)
-        handles = [None if g is None else
-                   allreduce_async(g, average=True, name=f"dgrad.{i}",
-                                   compression=self._compression)
-                   for i, g in enumerate(flat)]
-        flat = [g if h is None else synchronize(h)
-                for g, h in zip(flat, handles)]
+        if tf.executing_eagerly():
+            # sparse IndexedSlices grads keep the values+indices allgather
+            # path; dense grads ride the fused two-phase eager route
+            sparse = [i for i, g in enumerate(flat)
+                      if isinstance(g, tf.IndexedSlices)]
+            for i in sparse:
+                flat[i] = allreduce(flat[i], average=True,
+                                    name=f"dgrad.{i}",
+                                    compression=self._compression)
+            present = [i for i, g in enumerate(flat)
+                       if g is not None and i not in sparse]
+            dense = [tf.convert_to_tensor(flat[i]) for i in present]
+            reduced = _allreduce_grads(dense, self._compression)
+        else:  # inside tf.function: fused in-graph route (native op or
+            sparse = [i for i, g in enumerate(flat)  # py_function fallback)
+                      if isinstance(g, tf.IndexedSlices)]
+            tag = _fusion_tag(sources if isinstance(sources, (list,
+                              tuple)) else [sources])
+            for i in sparse:
+                flat[i] = _graph_sparse_allreduce(flat[i],
+                                                  f"dgrad.{tag}.{i}")
+            present = [i for i, g in enumerate(flat)
+                       if g is not None and i not in sparse]
+            dense = [tf.convert_to_tensor(flat[i]) for i in present]
+            reduced = _graph_fused_allreduce(dense, self._compression,
+                                             tag)
+        for i, r in zip(present, reduced):
+            flat[i] = r
         return _unflatten(flat, structure)
 
 
@@ -303,24 +348,47 @@ def _ingest_zero_copy(t):
         return np.array(t.numpy(), copy=True)
 
 
-def _graph_fused_allreduce(dense, compression):
+def _native_graph_ready():
+    """True when the compiled-graph collectives can run natively: the
+    libhvd_tf.so custom ops load and (for size>1) the plane's negotiation
+    + ring sockets are up. Brought up lazily on the first graph build —
+    every rank builds the same graph, so every rank reaches this
+    rendezvous."""
+    from . import native
+    if not native.available():
+        return False
+    return native.ensure_plane(rank(), size())
+
+
+def _graph_fused_allreduce(dense, compression, tag):
     """The in-graph gradient-averaging route for ``tf.function`` train
     steps — the role of the reference's AsyncOpKernel inside the graph
-    (tensorflow/mpi_ops.cc:276-304), built from graph ops instead of a
-    custom kernel:
+    (tensorflow/mpi_ops.cc:276-304):
 
       * the fusion buffer is IN-GRAPH: one ``tf.concat`` per dtype group
         (FuseResponses groups by dtype too, operations.cc:450-573), so
-        the host boundary sees one tensor per dtype, not one per gradient
-      * ONE ``tf.py_function`` per step crosses to the core; inbound
-        tensors enter jax zero-copy via dlpack, outbound results come
-        back as one buffer per group
+        the collective boundary sees one tensor per dtype, not one per
+        gradient
+      * when the native custom-op library is available (tensorflow/
+        native.py → _native/src/tf_ops.cc), each fused buffer is a REAL
+        ``HvdAllreduce`` graph node — an AsyncOpKernel over the native
+        rank-0-negotiated TCP ring, exactly the reference's architecture;
+        no Python anywhere on the step
+      * otherwise ONE ``tf.py_function`` per step crosses to the eager
+        core; inbound tensors enter jax zero-copy via dlpack, outbound
+        results come back as one buffer per group
       * ``tf.split`` + ``tf.reshape`` un-fuse in-graph
 
     A gradient without a fully-static shape cannot enter a fusion buffer
-    (py_function output shapes must be re-attached statically to split);
-    it rides the SAME single host call un-concatenated instead."""
+    (the un-fuse split needs static sizes); it rides the same route
+    un-concatenated instead.
+
+    Collective names carry ``tag`` (see _fusion_tag): two call sites in
+    one program (e.g. a GAN's two optimizers) would otherwise both emit
+    ``fused_grad.0`` and the name-keyed negotiation could pair different
+    tensors across ranks."""
     import tensorflow as tf
+
 
     static = [i for i, g in enumerate(dense)
               if g.shape.num_elements() is not None]
@@ -338,16 +406,21 @@ def _graph_fused_allreduce(dense, compression):
                      else tf.concat(flats, axis=0))
     buffers = fused + [dense[i] for i in dynamic]
 
-    def _host(*bufs):
-        handles = [_core.allreduce_async(_ingest_zero_copy(b), average=True,
-                                         name=f"fused_grad.{j}",
-                                         compression=compression,
-                                         kind="replicated")
-                   for j, b in enumerate(bufs)]
-        return [np.asarray(_core.synchronize(h)) for h in handles]
-
-    reduced = tf.py_function(_host, buffers,
-                             Tout=[b.dtype for b in buffers])
+    if _native_graph_ready():
+        from . import native
+        wire = getattr(compression, "wire_dtype", None)
+        wire_tf = (None if wire is None
+                   else tf.dtypes.as_dtype(np.dtype(wire).name))
+        reduced = []
+        for j, b in enumerate(buffers):
+            orig = b.dtype
+            if wire_tf is not None and orig.is_floating and orig != wire_tf:
+                b = tf.cast(b, wire_tf)  # in-graph compression (fp16/bf16)
+            r = native.allreduce(b, average=True,
+                                     name=f"fused_grad.{tag}.{j}")
+            reduced.append(tf.cast(r, orig) if r.dtype != orig else r)
+    else:
+        reduced = _pyfunc_fused_allreduce(buffers, compression, tag)
     if not isinstance(reduced, (list, tuple)):
         reduced = [reduced]
     outs = [None] * len(dense)
@@ -362,6 +435,54 @@ def _graph_fused_allreduce(dense, compression):
     return outs
 
 
+def _graph_sparse_allreduce(slices, name):
+    """IndexedSlices gradient inside a tf.function: keep the sparse
+    values+indices allgather semantics (reference tensorflow/__init__.py
+    :62-73) instead of densifying — an embedding gradient stays
+    proportional to the batch, not the vocabulary. Native allgather ops
+    when the plane is up, a py_function pair into the core otherwise."""
+    import tensorflow as tf
+
+    if _native_graph_ready():
+        from . import native
+        values = native.allgather(slices.values, name=name + ".values")
+        indices = native.allgather(slices.indices, name=name + ".indices")
+    else:
+        def _host_gather(suffix):
+            def fn(t):
+                h = _core.allgather_async(_ingest_zero_copy(t),
+                                          name=name + suffix,
+                                          kind="replicated")
+                return np.asarray(_core.synchronize(h))
+            return fn
+
+        values = tf.py_function(_host_gather(".values"), [slices.values],
+                                Tout=slices.values.dtype)
+        indices = tf.py_function(_host_gather(".indices"), [slices.indices],
+                                 Tout=slices.indices.dtype)
+        values.set_shape(tf.TensorShape([None]).concatenate(
+            slices.values.shape[1:]))
+        indices.set_shape([None])
+    return tf.IndexedSlices(values / size(), indices,
+                            dense_shape=slices.dense_shape)
+
+
+def _pyfunc_fused_allreduce(buffers, compression, tag):
+    """Fallback graph route: ONE tf.py_function per step into the eager
+    core (dlpack zero-copy in, one buffer per dtype group out)."""
+    import tensorflow as tf
+
+    def _host(*bufs):
+        handles = [_core.allreduce_async(_ingest_zero_copy(b), average=True,
+                                         name=f"fused_grad.{tag}.{j}",
+                                         compression=compression,
+                                         kind="replicated")
+                   for j, b in enumerate(bufs)]
+        return [np.asarray(_core.synchronize(h)) for h in handles]
+
+    return tf.py_function(_host, buffers, Tout=[b.dtype for b in buffers])
+
+
 def DistributedOptimizer(optimizer, compression=Compression.none):
     """Wrap a Keras optimizer so ``apply_gradients`` first averages the
     gradients across workers (reference DistributedOptimizer overriding
@@ -370,17 +491,19 @@ def DistributedOptimizer(optimizer, compression=Compression.none):
 
     Inside a compiled ``tf.function`` train step (Keras ``fit``), the
     gradients are fused IN-GRAPH into one buffer per dtype (tf.concat)
-    and cross to the core through ONE ``tf.py_function`` per step with
-    dlpack zero-copy ingestion — the role of the reference's custom
-    AsyncOpKernels (tensorflow/mpi_ops.cc:276-304); see
-    _graph_fused_allreduce. The single host call also keeps the
+    and reduced by REAL native ``HvdAllreduce`` AsyncOpKernels when
+    libhvd_tf.so is available (tensorflow/native.py; rank-0-negotiated
+    TCP ring in _native/src/tf_ops.cc — the reference's architecture,
+    tensorflow/mpi_ops.cc:276-304, with negotiation keeping the
     collective order identical on all workers regardless of TF's graph
-    scheduling. Measured seam cost: ~1 ms/step flat
+    scheduling). Without the native library the same fused buffers cross
+    to the eager core through ONE ``tf.py_function`` per step with
+    dlpack zero-copy ingestion — measured seam cost ~1 ms/step flat
     (tools/tf_pyfunc_bench.py; docs/migration.md has the table).
-    ``jit_compile=True`` works — XLA auto-clustering compiles the model
-    around the py_function, which runs between clusters — but plain
-    ``tf.function`` measured faster on CPU (clustering fragments the
-    step); prefer the default.
+    ``jit_compile=True`` works on either route — XLA auto-clustering
+    compiles the model around the collective node, which runs between
+    clusters — but plain ``tf.function`` measured faster on CPU
+    (clustering fragments the step); prefer the default.
 
     Keras-on-JAX note: the JAX trainer applies gradients via
     ``stateless_apply`` inside jit and never calls ``apply_gradients``, so
@@ -409,8 +532,8 @@ def DistributedOptimizer(optimizer, compression=Compression.none):
             if tf.executing_eagerly():
                 reduced = _allreduce_grads(dense, self._hvd_compression)
             else:
-                reduced = _graph_fused_allreduce(dense,
-                                                 self._hvd_compression)
+                reduced = _graph_fused_allreduce(
+                    dense, self._hvd_compression, _fusion_tag(variables))
             for i, r in zip(present, reduced):
                 grads[i] = r
             grads_and_vars = list(zip(grads, variables))

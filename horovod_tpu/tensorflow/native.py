@@ -1,0 +1,132 @@
+"""Native in-graph collectives for the TF frontend (libhvd_tf.so).
+
+The compiled-graph route the reference gets from its AsyncOpKernel custom
+ops (horovod/tensorflow/mpi_ops.cc:276-463, Python loader mpi_ops.py
+load_op_library): ``HvdAllreduce`` / ``HvdAllgather`` / ``HvdBroadcast``
+are real TF ops — a ``tf.function`` train step containing them is a pure
+compiled graph with no tf.py_function host seam, and the collective
+itself runs on the plane's native comm thread (rank-0 negotiation + TCP
+ring; see _native/src/tf_ops.cc).
+
+Loading is two-headed on the same .so: ``tf.load_op_library`` for the op
+defs, ``ctypes.CDLL`` for the extern-C plane control (init/shutdown).
+Everything degrades: if TF or a toolchain is absent, or
+``HVD_TF_NATIVE=0``, callers fall back to the py_function route in
+``horovod_tpu/tensorflow/__init__.py``.
+"""
+
+import atexit
+import ctypes
+import os
+
+from .. import _native
+from ..common import hvd_logging as log
+
+_state = {"ops": None, "cdll": None, "plane_up": False, "failed": False}
+
+
+def _load():
+    """Build/load libhvd_tf.so; returns the TF op module or None."""
+    if _state["ops"] is not None:
+        return _state["ops"]
+    if _state["failed"]:
+        return None
+    if os.environ.get("HVD_TF_NATIVE", "").lower() in ("0", "false"):
+        _state["failed"] = True
+        return None
+    try:
+        import tensorflow as tf
+        path = _native.build_tf()
+        _state["ops"] = tf.load_op_library(path)
+        cdll = ctypes.CDLL(path)
+        cdll.hvd_tf_init.restype = ctypes.c_int
+        cdll.hvd_tf_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_double]
+        cdll.hvd_tf_initialized.restype = ctypes.c_int
+        _state["cdll"] = cdll
+    except Exception as exc:  # noqa: BLE001 — no TF / no g++ / load error
+        log.debug(f"native TF ops unavailable, using py_function: {exc}")
+        _state["failed"] = True
+        return None
+    return _state["ops"]
+
+
+def available():
+    return _load() is not None
+
+
+# Port offset above the HVD_COORDINATOR_ADDR rendezvous port for the native
+# TF plane's own rank-0 listener (the Python negotiation plane derives its
+# ports the same way at +1000, ops/negotiation.py service_candidates).
+TF_PLANE_PORT_OFFSET = 1900
+
+
+def _plane_endpoint():
+    addr = os.environ.get("HVD_TF_NATIVE_ADDR")
+    if addr:
+        host, _, port = addr.rpartition(":")
+        try:
+            return host, int(port)
+        except ValueError:
+            log.warning(f"malformed HVD_TF_NATIVE_ADDR {addr!r} (want "
+                        "host:port); using py_function route")
+            return None
+    coord = os.environ.get("HVD_COORDINATOR_ADDR")
+    if not coord:
+        return None
+    host, _, port = coord.rpartition(":")
+    try:
+        return host, int(port) + TF_PLANE_PORT_OFFSET
+    except ValueError:
+        return None
+
+
+def ensure_plane(rank, size):
+    """Bring the native comm plane up (idempotent). Returns True when the
+    native in-graph path can be used. A failed bring-up is cached: the
+    bootstrap blocks up to HVD_TF_NATIVE_TIMEOUT, and _native_graph_ready
+    probes once per fused buffer per trace — re-attempting would turn one
+    absent rank into a multi-minute stall per retrace."""
+    if size <= 1:
+        return available()
+    if _state["failed"] or _load() is None:
+        return False
+    if _state["plane_up"]:
+        return True
+    ep = _plane_endpoint()
+    if ep is None:
+        log.debug("native TF plane: no HVD_COORDINATOR_ADDR / "
+                  "HVD_TF_NATIVE_ADDR rendezvous; using py_function")
+        return False
+    timeout = float(os.environ.get("HVD_TF_NATIVE_TIMEOUT", "60"))
+    rc = _state["cdll"].hvd_tf_init(rank, size, ep[0].encode(), ep[1],
+                                    timeout)
+    if rc != 0:
+        log.warning(f"native TF plane init failed (rank {rank}, "
+                    f"{ep[0]}:{ep[1]}); using py_function route")
+        _state["failed"] = True
+        return False
+    _state["plane_up"] = True
+    atexit.register(shutdown_plane)
+    return True
+
+
+def shutdown_plane():
+    if _state["plane_up"] and _state["cdll"] is not None:
+        _state["cdll"].hvd_tf_shutdown()
+        _state["plane_up"] = False
+
+
+def allreduce(tensor, average=True, name=""):
+    return _state["ops"].hvd_allreduce(tensor, average=average,
+                                       tensor_name=name)
+
+
+def allgather(tensor, name=""):
+    return _state["ops"].hvd_allgather(tensor, tensor_name=name)
+
+
+def broadcast(tensor, root_rank=0, name=""):
+    return _state["ops"].hvd_broadcast(tensor, root_rank=root_rank,
+                                       tensor_name=name)

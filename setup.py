@@ -29,6 +29,14 @@ class BuildNative(Command):
         from horovod_tpu import _native
         path = _native.build(force=True)
         print(f"built {path}")
+        try:
+            path = _native.build_tf(force=True)
+            print(f"built {path}")
+        except ImportError as exc:  # no TF in this env: optional extension
+            print(f"skipped libhvd_tf.so (TensorFlow unavailable): {exc}")
+        except Exception as exc:  # TF present but the compile broke: say so
+            print(f"WARNING: libhvd_tf.so build FAILED (the TF frontend "
+                  f"will use the py_function route): {exc}")
 
 
 setup(
@@ -37,7 +45,8 @@ setup(
     description="TPU-native distributed deep learning framework "
                 "(Horovod-capability, JAX/XLA/Pallas architecture)",
     packages=find_packages(exclude=("tests",)),
-    package_data={"horovod_tpu._native": ["libhvd_core.so", "src/*"]},
+    package_data={"horovod_tpu._native": ["libhvd_core.so", "libhvd_tf.so",
+                                          "src/*"]},
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "numpy"],
     cmdclass={"build_native": BuildNative},

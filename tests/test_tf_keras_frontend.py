@@ -174,7 +174,11 @@ class TestGraphFusedAllreduce:
     step, dlpack zero-copy ingestion — the AsyncOpKernel role
     (reference tensorflow/mpi_ops.cc:276-304)."""
 
-    def test_values_and_one_core_op_per_dtype_group(self, tfhvd):
+    def test_values_and_one_core_op_per_dtype_group(self, tfhvd,
+                                                    monkeypatch):
+        # pin the py_function fallback: the native AsyncOpKernel route has
+        # its own suite (test_tf_native_ops.py)
+        monkeypatch.setattr(tfhvd, "_native_graph_ready", lambda: False)
         core_names = []
         orig_async = tfhvd._core.allreduce_async
 
@@ -191,7 +195,8 @@ class TestGraphFusedAllreduce:
             @tf.function
             def f(a, b, c):
                 return tfhvd._graph_fused_allreduce(
-                    [a, b, c], tfhvd.Compression.none)
+                    [a, b, c], tfhvd.Compression.none,
+                    tfhvd._fusion_tag([a, b, c]))
 
             oa, ob, oc = f(a, b, c)
         finally:
@@ -203,8 +208,12 @@ class TestGraphFusedAllreduce:
         np.testing.assert_allclose(oc.numpy(), c.numpy())
         assert oa.dtype == tf.float32 and oc.dtype == tf.float64
         # THE contract: one core collective per dtype group (f32 fused
-        # a+b, f64 alone) — not one per gradient
-        assert core_names == ["fused_grad.0", "fused_grad.1"]
+        # a+b, f64 alone) — not one per gradient. Names carry a per-call
+        # tag so two fused call sites in one graph cannot collide.
+        assert len(core_names) == 2
+        assert [n.rsplit(".", 1)[-1] for n in core_names] == ["0", "1"]
+        assert all(n.startswith("fused_grad.") for n in core_names)
+        assert len({n.rsplit(".", 1)[0] for n in core_names}) == 1
 
     def test_two_process_graph_mode_training_averages(self):
         """End-to-end tf.function training across 2 real processes: the
@@ -218,6 +227,8 @@ class TestGraphFusedAllreduce:
             import tensorflow as tf
             import horovod_tpu.tensorflow as hvd
             hvd.init()
+            # pin the py_function fallback (native route tested separately)
+            hvd._native_graph_ready = lambda: False
             r = int(os.environ["HVD_PROCESS_ID"])
             v = tf.Variable([2.0, 4.0])
             opt = hvd.DistributedOptimizer(
