@@ -101,11 +101,13 @@ static inline float f16_to_f32(uint16_t h) {
   if (exp == 0) {
     if (mant == 0) {
       bits = sign;
-    } else {  // subnormal: normalize
+    } else {  // subnormal (mant * 2^-24): normalize
       int shift = 0;
       while (!(mant & 0x400u)) { mant <<= 1; ++shift; }
       mant &= 0x3ffu;
-      bits = sign | ((127 - 15 - shift) << 23) | (mant << 13);
+      // one normalization shift is implied by the hidden bit: biased
+      // exponent is 113 - shift (112 - shift would halve every value)
+      bits = sign | ((113 - shift) << 23) | (mant << 13);
     }
   } else if (exp == 31) {
     bits = sign | 0x7f800000u | (mant << 13);  // inf/nan
@@ -452,6 +454,7 @@ struct PendingGen {             // rank-0 per-name negotiation state
   size_t count = 0;
   uint32_t op = 0;
   uint32_t dtype = 0;
+  bool average = false;
   uint64_t nbytes = 0;
   uint64_t root = 0;
   uint64_t row_bytes = 0;       // allgather: agreed nbytes/dim0
@@ -513,7 +516,8 @@ class Plane {
       hosts[0] = coord_host;
       ports[0] = ring_port;
       ctrl_fds_.assign(size_, -1);
-      for (int i = 1; i < size_; ++i) {
+      int joined = 0;
+      while (joined < size_ - 1) {
         // bounded wait: a worker that never joins (failed native build,
         // HVD_TF_NATIVE=0 on its host) must fail THIS init too, so every
         // rank falls back to the py_function route together
@@ -528,17 +532,23 @@ class Plane {
         if (cfd < 0) { ::close(lfd); ::close(ring_listen); return false; }
         set_nodelay(cfd);
         Msg hello;
-        if (!wait_readable(cfd, deadline) || !recv_msg(cfd, &hello) ||
-            hello.hdr.type != HELLO) {
-          ::close(lfd); ::close(ring_listen);
-          return false;
+        int r = -1;
+        if (wait_readable(cfd, deadline) && recv_msg(cfd, &hello) &&
+            hello.hdr.type == HELLO)
+          r = static_cast<int>(hello.hdr.a);
+        if (r < 1 || r >= size_ || ctrl_fds_[r] >= 0) {
+          // stray client (port scan, health probe), malformed HELLO, or a
+          // duplicate rank from a double-launched worker: drop the
+          // connection, keep waiting for the real ranks until deadline
+          ::close(cfd);
+          continue;
         }
-        int r = static_cast<int>(hello.hdr.a);
         char ip[INET_ADDRSTRLEN];
         ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
         hosts[r] = ip;
         ports[r] = static_cast<uint16_t>(hello.hdr.b);
         ctrl_fds_[r] = cfd;
+        ++joined;
       }
       ::close(lfd);
       // endpoint table: "host:port\n" per rank
@@ -631,12 +641,12 @@ class Plane {
 
   // TF executor threads land here (ComputeAsync)
   void enqueue(const std::string& name, Entry e) {
-    // READY wire encoding: a = op | dtype<<8, b = dim0 (allgather) or
-    // root (broadcast), payload = u64 nbytes — the coordinator validates
-    // op/dtype/size agreement across ranks before ordering execution
-    // (the reference's ConstructResponse error checking,
-    // operations.cc:198-400)
-    uint32_t a = e.op | (e.dtype << 8);
+    // READY wire encoding: a = op | dtype<<8 | average<<16, b = dim0
+    // (allgather) or root (broadcast), payload = u64 nbytes — the
+    // coordinator validates op/dtype/size/average agreement across ranks
+    // before ordering execution (the reference's ConstructResponse error
+    // checking, operations.cc:198-400)
+    uint32_t a = e.op | (e.dtype << 8) | (e.average ? 1u << 16 : 0);
     uint64_t b = e.op == BROADCAST ? static_cast<uint64_t>(e.root) : e.dim0;
     uint64_t nbytes = e.nbytes;
     bool dead = false;
@@ -688,7 +698,8 @@ class Plane {
   void note_ready(int from_rank, const std::string& name, uint32_t a,
                   uint64_t b, uint64_t nbytes) {
     uint32_t op = a & 0xff;
-    uint32_t dtype = a >> 8;
+    uint32_t dtype = (a >> 8) & 0xff;
+    bool average = (a >> 16) & 1;
     auto& gens = negotiating_[name];
     PendingGen* gen = nullptr;
     for (auto& g : gens)
@@ -700,9 +711,11 @@ class Plane {
       gen->dim0s.assign(size_, 0);
       gen->op = op;
       gen->dtype = dtype;
+      gen->average = average;
       gen->nbytes = nbytes;
       gen->root = op == BROADCAST ? b : 0;
     } else if (gen->op != op || gen->dtype != dtype ||
+               gen->average != average ||
                (op != ALLGATHER && gen->nbytes != nbytes) ||
                (op == BROADCAST && gen->root != b)) {
       // same name, different op/dtype/size/root across ranks: executing
@@ -1195,6 +1208,12 @@ class HvdBroadcastOp : public tf::AsyncOpKernel {
                       tf::errors::FailedPrecondition(
                           "native plane not initialized — call hvd.init()"),
                       done);
+    OP_REQUIRES_ASYNC(
+        ctx, root_rank_ >= 0 && root_rank_ < plane.size(),
+        tf::errors::InvalidArgument(
+            "broadcast root_rank out of range (no rank would send: the "
+            "ring would stall to its IO timeout and tear the plane down)"),
+        done);
     int code = dtype_code(input.dtype());
     OP_REQUIRES_ASYNC(ctx, code >= 0,
                       tf::errors::InvalidArgument("unsupported dtype"),

@@ -164,6 +164,10 @@ class Attention(nn.Module):
         q, k, v = map(heads, (q, k, v))  # [b, s, h, d]
         q = _rope(q.swapaxes(1, 2), positions).swapaxes(1, 2)
         k = _rope(k.swapaxes(1, 2), positions).swapaxes(1, 2)
+        # (measured: routing the flash path through layout="bhsd" to skip
+        # the kernel-side transposes is step-time neutral on v5e — XLA
+        # already cancels the swapaxes/transpose pairs; see
+        # docs/benchmarks.md flash-kernel lessons)
         out = _dispatch_attention(cfg, q, k, v, self.sp)
         out = out.reshape(out.shape[:2] + (cfg.d_model,))
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,

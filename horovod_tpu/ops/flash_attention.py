@@ -181,9 +181,17 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
         sem_v=pltpu.SemaphoreType.DMA((2,)))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None,
+               layout="bshd"):
+    if layout == "bhsd":
+        # head-major: the flatten to [b*h, s, d] is a free reshape — the
+        # caller (e.g. the transformer block, which is in this layout for
+        # RoPE anyway) skips the transpose pair around the kernel
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+    else:
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
@@ -192,10 +200,15 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
             f"q {sq}%{block_q}, k {sk}%{block_k}")
     if scale is None:
         scale = d ** -0.5
-    # [b, s, h, d] → [b*h, s, d]: each program handles one (batch, head)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    if layout == "bhsd":
+        qf = q.reshape(b * h, sq, d)
+        kf = k.reshape(b * h, sk, d)
+        vf = v.reshape(b * h, sk, d)
+    else:
+        # [b, s, h, d] → [b*h, s, d]: each program handles one (batch, head)
+        qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+        kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+        vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, seq_k=sk, causal=causal,
@@ -222,6 +235,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None):
         ],
         interpret=interpret if interpret is not None else _auto_interpret(),
     )(qf, kf, vf)
+    if layout == "bhsd":
+        return out.reshape(b, h, sq, d), lse
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
 
 
@@ -368,9 +383,14 @@ def _dkv_kernel(k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
-               scale=None, block_q_dkv=None, block_k_dkv=None):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+               scale=None, block_q_dkv=None, block_k_dkv=None,
+               layout="bshd"):
+    if layout == "bhsd":
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+    else:
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     # the dK/dV kernel streams Q-side tiles and grids over K blocks —
@@ -387,6 +407,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
     interpret = interpret if interpret is not None else _auto_interpret()
 
     def flat(t, s):
+        if layout == "bhsd":
+            return t.reshape(b * h, s, d)
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     qf, kf, vf = flat(q, sq), flat(k, sk), flat(v, sk)
@@ -444,6 +466,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
     )(kf, vf, qf, dof, lse, delta)
 
     def unflat(t, s):
+        if layout == "bhsd":
+            return t.reshape(b, h, s, d)
         return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
     return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
@@ -461,22 +485,29 @@ def fit_block(block, s):
     return b
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_core(q, k, v, causal, block_q, block_k, interpret, scale,
-                block_q_dkv, block_k_dkv):
+                block_q_dkv, block_k_dkv, layout):
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
-                        scale=scale)
+                        scale=scale, layout=layout)
     return out
 
 
 def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
-                    interpret=None, block_q_dkv=None, block_k_dkv=None):
-    """Fused attention; q/k/v [batch, seq, heads, head_dim], causal mask in
-    global positions. Numerically equivalent to
-    parallel.ring.full_attention (exact softmax, fp32 accumulation), in
-    forward and backward, with O(s·d) memory in both. Default 512-blocks
-    measured fastest on v5e (b8 s1024 h12 d64, 12 layers fwd+bwd:
-    34.7 ms at 512 vs 76.8 ms at 128; XLA full attention 49.4 ms).
+                    interpret=None, block_q_dkv=None, block_k_dkv=None,
+                    layout="bshd"):
+    """Fused attention; q/k/v [batch, seq, heads, head_dim] (or
+    [batch, heads, seq, head_dim] with ``layout="bhsd"`` — the flatten to
+    the kernel's physical [batch·heads, seq, head_dim] is then a free
+    reshape, so a caller already in head-major layout, like the
+    transformer block around RoPE, skips the transpose pair the default
+    layout inserts on every operand and gradient). Causal mask in global
+    positions. Numerically equivalent to parallel.ring.full_attention
+    (exact softmax, fp32 accumulation), in forward and backward, with
+    O(s·d) memory in both. Default 512-blocks measured fastest on v5e
+    (b8 s1024 h12 d64, 12 layers fwd+bwd: 34.7 ms at 512 vs 76.8 ms at
+    128; XLA full attention 49.4 ms).
 
     Sequence lengths need not divide the block sizes for causal
     self-attention (sq == sk): inputs are end-padded to the next block
@@ -486,7 +517,10 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
     doesn't carry, so they raise. On real TPU, head_dim is zero-padded to
     the 128-lane tile (softmax scale keeps the true head_dim; zero columns
     drop out of every dot product)."""
-    sq, sk = q.shape[1], k.shape[1]
+    if layout not in ("bshd", "bhsd"):
+        raise ValueError(f"unknown layout {layout!r}")
+    seq_axis = 2 if layout == "bhsd" else 1
+    sq, sk = q.shape[seq_axis], k.shape[seq_axis]
     d = q.shape[-1]
     scale = d ** -0.5
     bq, bk = fit_block(block_q, sq), fit_block(block_k, sk)
@@ -498,34 +532,38 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
             f"flash_attention needs seq divisible by block sizes unless "
             f"causal self-attention: q {sq}%{bq}, k {sk}%{bk}")
     if pad_q or pad_k:
-        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
-        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        def seq_pad(t, p):
+            pads = [(0, 0)] * 4
+            pads[seq_axis] = (0, p)
+            return jnp.pad(t, pads)
+        q, k, v = seq_pad(q, pad_q), seq_pad(k, pad_k), seq_pad(v, pad_k)
     interpret_eff = interpret if interpret is not None else _auto_interpret()
     pad_d = 0 if interpret_eff else -d % 128
     if pad_d:
         pads = ((0, 0), (0, 0), (0, 0), (0, pad_d))
         q, k, v = jnp.pad(q, pads), jnp.pad(k, pads), jnp.pad(v, pads)
     out = _flash_core(q, k, v, causal, bq, bk, interpret_eff, scale,
-                      bq2, bk2)
+                      bq2, bk2, layout)
     if pad_d:
         out = out[..., :d]
-    return out[:, :sq] if pad_q else out
+    if pad_q:
+        out = out[:, :, :sq] if layout == "bhsd" else out[:, :sq]
+    return out
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret, scale,
-             block_q_dkv, block_k_dkv):
+             block_q_dkv, block_k_dkv, layout):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
-                          scale=scale)
+                          scale=scale, layout=layout)
     return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_k, interpret, scale, block_q_dkv,
-             block_k_dkv, residuals, g):
+             block_k_dkv, layout, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
                       interpret, scale=scale, block_q_dkv=block_q_dkv,
-                      block_k_dkv=block_k_dkv)
+                      block_k_dkv=block_k_dkv, layout=layout)
 
 
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)

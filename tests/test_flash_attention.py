@@ -25,6 +25,38 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_bhsd_layout_matches_bshd(self, hvd):
+        """layout="bhsd" (head-major operands, reshape-only flatten) is
+        numerically identical to the default layout, forward and
+        backward, including the indivisible-seq padding path."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import flash_attention
+        rng = np.random.RandomState(3)
+        q, k, v = (jnp.asarray(rng.randn(2, 45, 3, 16), jnp.float32)
+                   for _ in range(3))
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+        def bshd(q, k, v):
+            return flash_attention(q, k, v, causal=True, block_q=32,
+                                   block_k=32)
+
+        def bhsd(q, k, v):
+            return flash_attention(
+                q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+                causal=True, block_q=32, block_k=32,
+                layout="bhsd").swapaxes(1, 2)
+
+        np.testing.assert_allclose(np.asarray(bshd(q, k, v)),
+                                   np.asarray(bhsd(q, k, v)), atol=1e-5)
+        g1 = jax.grad(loss(bshd), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss(bhsd), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_single_block(self, hvd):
         from horovod_tpu.ops.flash_attention import flash_attention
         from horovod_tpu.parallel.ring import full_attention

@@ -96,6 +96,13 @@ class TestMultiProcess:
                     tf.cast(tf.fill([64], float(rank + 1)), tf.float16),
                     average=False, name="t.h")
                 report["sum_f16"] = float(tf.cast(rh, tf.float32).numpy()[0])
+                # subnormal f16 (2^-15 < 2^-14): the software sum must
+                # decode subnormals at full value, not half
+                rs = native.allreduce(
+                    tf.fill([16], tf.cast(2.0 ** -15, tf.float16)),
+                    average=False, name="t.s")
+                report["sum_f16_subnormal"] = float(
+                    tf.cast(rs, tf.float32).numpy()[0])
 
                 # allgatherv: per-rank first dims differ (rank+1 rows)
                 rg = native.allgather(
@@ -137,6 +144,7 @@ class TestMultiProcess:
                                        np.arange(5) * (total / 3))
             assert rep["sum_bf16"] == total
             assert rep["sum_f16"] == total
+            assert rep["sum_f16_subnormal"] == 3 * 2.0 ** -15
             assert rep["gathered"] == exp_gather
             assert rep["bcast"] == 10.0
             np.testing.assert_allclose(
@@ -212,18 +220,33 @@ class TestMultiProcess:
                     native.allreduce(tf.zeros([4 + rank]), name="clash")
                 except tf.errors.OpError as e:
                     got_error = "mismatched" in str(e)
+                avg_error = False
+                try:
+                    native.allreduce(tf.zeros([4]), average=rank == 0,
+                                     name="clash.avg")
+                except tf.errors.OpError as e:
+                    avg_error = "mismatched" in str(e)
+                root_error = False
+                try:
+                    native.broadcast(tf.zeros([4]), root_rank=5,
+                                     name="clash.root")
+                except tf.errors.OpError as e:
+                    root_error = "out of range" in str(e)
                 # the plane survives: a well-formed collective still works
                 out = native.allreduce(tf.fill([8], float(rank + 1)),
                                        average=False, name="after")
-                return got_error, float(out.numpy()[0])
+                return (got_error, avg_error, root_error,
+                        float(out.numpy()[0]))
             finally:
                 native.shutdown_plane()
 
         results = run(worker, num_proc=2, env=_ENV)
         if results[0] == "unavailable":
             pytest.skip("libhvd_tf.so unavailable in workers")
-        for got_error, after in results:
+        for got_error, avg_error, root_error, after in results:
             assert got_error, "size mismatch did not raise"
+            assert avg_error, "average-mode mismatch did not raise"
+            assert root_error, "out-of-range root did not raise"
             assert after == 3.0
 
     def test_absent_rank_falls_back_to_pyfunc_everywhere(self):
