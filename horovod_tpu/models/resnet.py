@@ -70,12 +70,22 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # "flax": stock nn.BatchNorm (the fast path on v5e — XLA's fused
+    # convert+reduce stats and conv-epilogue normalize measured faster
+    # than the Pallas alternative, see ops/batch_norm.py); "tpu":
+    # ops.batch_norm.TpuBatchNorm. Numerics match (tests/test_batch_norm).
+    norm_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train=False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
                                  padding="SAME")
-        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+        from ..ops.batch_norm import TpuBatchNorm
+        if self.norm_impl not in ("flax", "tpu"):
+            raise ValueError(
+                f"norm_impl={self.norm_impl!r}: expected 'flax' or 'tpu'")
+        norm_cls = TpuBatchNorm if self.norm_impl == "tpu" else nn.BatchNorm
+        norm = functools.partial(norm_cls, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
                                  dtype=self.dtype)
         x = x.astype(self.dtype)
