@@ -80,11 +80,15 @@ class ResNet(nn.Module):
     def __call__(self, x, train=False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
                                  padding="SAME")
-        from ..ops.batch_norm import TpuBatchNorm
         if self.norm_impl not in ("flax", "tpu"):
             raise ValueError(
                 f"norm_impl={self.norm_impl!r}: expected 'flax' or 'tpu'")
-        norm_cls = TpuBatchNorm if self.norm_impl == "tpu" else nn.BatchNorm
+        if self.norm_impl == "tpu":
+            # import confined here: the experimental pallas dependency
+            # stays off the default flax path
+            from ..ops.batch_norm import TpuBatchNorm as norm_cls
+        else:
+            norm_cls = nn.BatchNorm
         norm = functools.partial(norm_cls, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
                                  dtype=self.dtype)

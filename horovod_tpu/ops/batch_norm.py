@@ -37,11 +37,7 @@ from flax import linen as nn
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _out_struct  # vma-aware ShapeDtypeStruct
-
-
-def _auto_interpret():
-    return jax.default_backend() != "tpu"
+from .flash_attention import _auto_interpret, _out_struct
 
 
 # sequential grid: every step accumulates into the same [1, C] output
@@ -60,7 +56,17 @@ def _pick_block(rows, channels, budget_bytes=2 << 20, inputs=1):
     block = min(block, 65536)
     while block > 8 and rows % block:
         block //= 2
-    return block if rows % block == 0 else rows
+    if rows % block == 0:
+        return block
+    # rows not a multiple of 8: a whole-array tile is only safe when it
+    # actually fits VMEM; otherwise the caller must pad (conv activations
+    # are 8-aligned in practice, so this path is tiny-input territory)
+    if rows * channels * 2 * inputs <= budget_bytes:
+        return rows
+    raise ValueError(
+        f"moments: {rows} rows (not 8-aligned) x {channels} channels "
+        f"exceeds the single-tile VMEM budget; pad rows to a multiple "
+        "of 8")
 
 
 def _moments1_kernel(x_ref, s_ref, ss_ref):

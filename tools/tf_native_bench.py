@@ -1,0 +1,79 @@
+"""A/B the TF frontend's two compiled-graph collective routes across 2
+real processes: native AsyncOpKernel custom ops (libhvd_tf.so — rank-0
+negotiation + TCP ring) vs the single-tf.py_function fallback into the
+eager core. Single host, so the wire is loopback — what's measured is
+the per-step seam: graph-node dispatch + negotiation round-trip + ring
+copy for native, vs py_function + dlpack + core enqueue/synchronize +
+device collective for the fallback.
+
+The resulting rows live in docs/migration.md next to the single-process
+py_function table (tools/tf_pyfunc_bench.py).
+
+Usage: python tools/tf_native_bench.py [--steps 60] [--params 100352]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--params", type=int, default=100352,
+                    help="model parameter count (~the MNIST CNN's 100k)")
+    args = ap.parse_args()
+
+    from horovod_tpu.run.launch import run
+
+    def worker(steps, n_params, native_on):
+        import os
+        import time
+        if not native_on:
+            os.environ["HVD_TF_NATIVE"] = "0"
+        import numpy as np
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        from horovod_tpu.tensorflow import native
+
+        hvd.init()
+        v = tf.Variable(np.random.RandomState(0).rand(n_params)
+                        .astype(np.float32))
+        opt = hvd.DistributedOptimizer(
+            __import__("keras").optimizers.SGD(1e-6))
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(tf.square(v - x))
+            opt.apply_gradients(zip(tape.gradient(loss, [v]), [v]))
+            return loss
+
+        x = tf.constant(0.5)
+        float(step(x))  # trace + plane bring-up
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step(x)
+        float(out)
+        dt = (time.perf_counter() - t0) / steps * 1e3
+        used_native = native._state["plane_up"]
+        hvd.shutdown()
+        return dt, bool(used_native)
+
+    env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    for label, native_on in (("native AsyncOpKernel ring", True),
+                             ("py_function -> eager core", False)):
+        results = run(worker, args=(args.steps, args.params, native_on),
+                      num_proc=2, env=env)
+        ms = max(r[0] for r in results)
+        used = all(r[1] for r in results) if native_on else not any(
+            r[1] for r in results)
+        tag = "" if used else "  (route NOT engaged as intended!)"
+        print(f"{label:<28} {ms:7.2f} ms/step  "
+              f"({args.params} params, 2 procs){tag}")
+
+
+if __name__ == "__main__":
+    main()
