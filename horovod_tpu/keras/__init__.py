@@ -4,8 +4,10 @@ TPU-native equivalent of the reference's Keras adapters (`horovod/_keras/`
 shared impl, `horovod/keras/` and `horovod/tensorflow/keras/` wrappers).
 The callbacks are backend-agnostic (weights move as numpy through the
 core); ``DistributedOptimizer`` intercepts ``apply_gradients`` and so
-serves the TF backend — on the Keras JAX backend (gradients applied
-inside jit via ``stateless_apply``) it raises and points to the pure-JAX
+serves the TF backend. On the Keras JAX backend (gradients applied
+inside jit via ``stateless_apply``, out of any wrapper's reach) the
+story is ``use_jax_distribution()`` — Keras's own JAX DataParallel over
+this framework's devices — or the pure-JAX
 ``horovod_tpu.optim.DistributedOptimizer`` path.
 
     import horovod_tpu.keras as hvd
@@ -37,6 +39,45 @@ def broadcast_global_variables(model, root_rank=0):
                                      name=f"kbcast.{i}", kind="replicated")
                for i, w in enumerate(weights)]
     model.set_weights([np.asarray(_core.synchronize(h)) for h in handles])
+
+
+def jax_distribution(mesh=None):
+    """The Keras-on-JAX data-parallel story: a
+    ``keras.distribution.DataParallel`` over this framework's devices —
+    Keras's JAX trainer then shards ``fit`` batches and inserts the
+    gradient psum itself (inside its jit step, where an
+    apply_gradients-intercepting optimizer wrapper cannot reach; that is
+    why ``DistributedOptimizer`` raises on this backend).
+
+    Pass a ``parallel.mesh`` mesh to reuse its device order (e.g. the
+    'hvd' data axis built by ``hvd.init``); default is every visible
+    device — which, with ``jax.distributed`` initialized by
+    ``hvd.init()`` on multiple hosts, is the GLOBAL device list, so the
+    same two lines scale from one chip to a pod:
+
+        import horovod_tpu.keras as hvd
+        hvd.init()
+        hvd.use_jax_distribution()
+        model.fit(...)   # data-parallel across every chip
+    """
+    import keras
+    if keras.backend.backend() != "jax":
+        raise ValueError(
+            "jax_distribution() is for the Keras JAX backend; on the "
+            "TensorFlow backend use hvd.DistributedOptimizer")
+    import jax
+    devices = (list(mesh.devices.flat) if mesh is not None
+               else jax.devices())
+    return keras.distribution.DataParallel(devices=devices)
+
+
+def use_jax_distribution(mesh=None):
+    """Install ``jax_distribution(mesh)`` as the process-global Keras
+    distribution (``keras.distribution.set_distribution``); returns it."""
+    import keras
+    dist = jax_distribution(mesh)
+    keras.distribution.set_distribution(dist)
+    return dist
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None):

@@ -45,12 +45,21 @@ from .flash_attention import _auto_interpret, _out_struct
 _SEQ = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
 
 
-def _pick_block(rows, channels, budget_bytes=2 << 20, inputs=1):
+# a lone [rows, C] tile has no double-buffering; what bounds it is the
+# ~16 MB scoped VMEM minus the fp32 intermediates of the reduction
+# (input bf16 tile + ~2x for the f32 cast) — ~5 MB of input is safe
+_SINGLE_TILE_LIMIT = 5 << 20
+
+
+def _pick_block(rows, channels, budget_bytes=2 << 20, inputs=1,
+                compiled=True):
     """Largest row-block that divides ``rows``, keeps ``inputs`` bf16
     [block, C] tiles within the VMEM budget, and stays a multiple of 8
-    (the f32 sublane); falls back to ``rows`` itself for tiny inputs.
-    Big blocks matter: the sequential accumulation grid pays a fixed
-    per-step cost, so fewer/fatter DMA tiles win (measured on v5e)."""
+    (the f32 sublane). Big blocks matter: the sequential accumulation
+    grid pays a fixed per-step cost, so fewer/fatter DMA tiles win
+    (measured on v5e). Non-8-aligned row counts fall back to one
+    whole-array tile — unbounded in interpret mode (``compiled=False``),
+    VMEM-capped when compiling for real hardware."""
     cap = max(8, budget_bytes // max(1, channels * 2 * inputs))
     block = 1 << max(3, (cap.bit_length() - 1))
     block = min(block, 65536)
@@ -58,15 +67,11 @@ def _pick_block(rows, channels, budget_bytes=2 << 20, inputs=1):
         block //= 2
     if rows % block == 0:
         return block
-    # rows not a multiple of 8: a whole-array tile is only safe when it
-    # actually fits VMEM; otherwise the caller must pad (conv activations
-    # are 8-aligned in practice, so this path is tiny-input territory)
-    if rows * channels * 2 * inputs <= budget_bytes:
+    if not compiled or rows * channels * 2 * inputs <= _SINGLE_TILE_LIMIT:
         return rows
     raise ValueError(
-        f"moments: {rows} rows (not 8-aligned) x {channels} channels "
-        f"exceeds the single-tile VMEM budget; pad rows to a multiple "
-        "of 8")
+        f"moments: {rows} rows (not a multiple of 8) x {channels} "
+        "channels cannot tile for VMEM; pad rows to a multiple of 8")
 
 
 def _moments1_kernel(x_ref, s_ref, ss_ref):
@@ -114,7 +119,7 @@ def moments(x, interpret=None):
     xf = _flat(x)
     rows, c = xf.shape
     interpret = interpret if interpret is not None else _auto_interpret()
-    block = _pick_block(rows, c)
+    block = _pick_block(rows, c, compiled=not interpret)
     s, ss = pl.pallas_call(
         _moments1_kernel,
         grid=(rows // block,),
@@ -135,7 +140,7 @@ def moments2(a, b, interpret=None):
     af, bf = _flat(a), _flat(b)
     rows, c = af.shape
     interpret = interpret if interpret is not None else _auto_interpret()
-    block = _pick_block(rows, c, inputs=2)
+    block = _pick_block(rows, c, inputs=2, compiled=not interpret)
     sa, sab = pl.pallas_call(
         _moments2_kernel,
         grid=(rows // block,),

@@ -507,18 +507,22 @@ def DistributedOptimizer(optimizer, compression=Compression.none):
 
     Keras-on-JAX note: the JAX trainer applies gradients via
     ``stateless_apply`` inside jit and never calls ``apply_gradients``, so
-    this wrapper cannot intercept it — use the pure-JAX path
+    this wrapper cannot intercept it — use
+    ``horovod_tpu.keras.use_jax_distribution()`` (Keras's own JAX
+    DataParallel over this framework's devices) or the pure-JAX path
     (``horovod_tpu.optim.DistributedOptimizer`` over optax with
-    ``trainer.make_data_parallel_step``) for distributed Keras-on-JAX
-    training; a guard below raises rather than silently skip averaging."""
+    ``trainer.make_data_parallel_step``); a guard below raises rather
+    than silently skip averaging."""
     import keras
     if keras.backend.backend() == "jax" and size() > 1:
         raise ValueError(
             "DistributedOptimizer cannot intercept gradient application on "
             "the Keras JAX backend (stateless_apply runs inside jit and "
             "bypasses apply_gradients) — gradients would silently go "
-            "un-averaged. Use horovod_tpu.optim.DistributedOptimizer with "
-            "trainer.make_data_parallel_step for JAX training.")
+            "un-averaged. Use horovod_tpu.keras.use_jax_distribution() "
+            "(Keras JAX DataParallel over the framework's devices) or "
+            "horovod_tpu.optim.DistributedOptimizer with "
+            "trainer.make_data_parallel_step.")
     import tensorflow as tf
     base_cls = optimizer.__class__
 
