@@ -66,6 +66,10 @@ def parse_args():
                    help="expert-parallel ways (MoE experts shard over 'ep')")
     p.add_argument("--num-experts", type=int, default=0,
                    help="experts per MoE layer; 0 = dense MLP")
+    p.add_argument("--remat-policy", default=None,
+                   choices=["dots", "dots_no_batch"],
+                   help="jax.checkpoint policy under --remat (default: "
+                        "save nothing)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (HBM for FLOPs)")
     p.add_argument("--vocab-chunk", type=int, default=0,
@@ -91,6 +95,7 @@ def main():
     verbose = hvd.process_rank() == 0
 
     cfg = SIZES[args.size](attention_impl=args.attention, remat=args.remat,
+                           remat_policy=args.remat_policy,
                            num_experts=args.num_experts)
     seq = args.seq_len or min(cfg.max_seq_len, 256)
     batch = args.batch_size * dp

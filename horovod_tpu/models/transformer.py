@@ -37,6 +37,11 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # jax.checkpoint policy under remat: None saves nothing (max memory
+    # savings, full recompute); "dots" saves every matmul result;
+    # "dots_no_batch" saves only batch-dim-free dots (projection/MLP
+    # outputs — attention recomputed; the usual transformer sweet spot)
+    remat_policy: Optional[str] = None
     # share the input embedding matrix with the lm_head (GPT-2 ties
     # them); saves d_model*vocab params and the separate head-matrix
     # optimizer update, and removes one [vocab, d] gradient scatter-add
@@ -248,7 +253,18 @@ class TransformerLM(nn.Module):
         positions = (offset + jnp.arange(s_loc))[None, :]
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+            policies = {
+                None: None,
+                "dots": jax.checkpoint_policies.dots_saveable,
+                "dots_no_batch":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy={cfg.remat_policy!r}: expected one of "
+                    f"{sorted(k or 'None' for k in policies)}")
+            block = nn.remat(Block, static_argnums=(),
+                             policy=policies[cfg.remat_policy])
         for i in range(cfg.num_layers):
             x = block(cfg, sp=sp, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
