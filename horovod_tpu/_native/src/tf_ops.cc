@@ -388,7 +388,37 @@ static bool wait_readable(int fd, std::chrono::steady_clock::time_point
   }
 }
 
+// Every header starts with a magic word (endianness-sensitive: a
+// byte-swapped peer produces a non-matching value) and a wire version.
+// A HELLO from a mismatched build or a heterogeneous-endianness host is
+// rejected at bootstrap instead of being interpreted as garbage ranks.
+static constexpr uint32_t kWireMagic = 0x48564454;  // "HVDT"
+static constexpr uint32_t kWireVersion = 2;         // bump on MsgHdr change
+
+// Bound a socket's blocking reads by the bootstrap deadline: a peer that
+// sends a short/older header (fewer bytes than MsgHdr) must time the
+// read out instead of stalling recv_msg inside the accept loop forever —
+// wait_readable only guarantees the FIRST byte, not the whole header.
+static void set_recv_deadline(int fd,
+                              std::chrono::steady_clock::time_point
+                                  deadline) {
+  auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+      deadline - std::chrono::steady_clock::now()).count();
+  if (left < 1) left = 1;
+  struct timeval tv;
+  tv.tv_sec = left / 1000;
+  tv.tv_usec = (left % 1000) * 1000;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+static void clear_recv_deadline(int fd) {
+  struct timeval tv = {0, 0};  // back to blocking (comm loop polls first)
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 struct MsgHdr {         // fixed header; name + payload follow
+  uint32_t magic;
+  uint32_t version;
   uint32_t type;
   uint32_t name_len;
   uint64_t a;           // HELLO: rank      READY/ORDER: op
@@ -405,7 +435,8 @@ struct Msg {
 static bool send_msg(int fd, std::mutex* m, uint32_t type,
                      const std::string& name, uint64_t a, uint64_t b,
                      const void* payload = nullptr, size_t plen = 0) {
-  MsgHdr h = {type, static_cast<uint32_t>(name.size()), a, b,
+  MsgHdr h = {kWireMagic, kWireVersion, type,
+              static_cast<uint32_t>(name.size()), a, b,
               static_cast<uint64_t>(plen)};
   std::lock_guard<std::mutex> lock(*m);
   if (!write_full(fd, &h, sizeof(h))) return false;
@@ -417,6 +448,15 @@ static bool send_msg(int fd, std::mutex* m, uint32_t type,
 
 static bool recv_msg(int fd, Msg* out) {
   if (!read_full(fd, &out->hdr, sizeof(out->hdr))) return false;
+  if (out->hdr.magic != kWireMagic || out->hdr.version != kWireVersion) {
+    // fail loudly: this is a build/endianness mismatch, not a flaky peer
+    std::fprintf(stderr,
+                 "[hvd_tf] control-plane peer speaks wire magic=%08x "
+                 "version=%u (want %08x/%u) — mismatched build or "
+                 "endianness; rejecting connection\n",
+                 out->hdr.magic, out->hdr.version, kWireMagic, kWireVersion);
+    return false;
+  }
   if (out->hdr.name_len > (1u << 20) || out->hdr.payload_len > (1u << 30))
     return false;  // corrupt header
   out->name.resize(out->hdr.name_len);
@@ -442,6 +482,7 @@ struct Entry {
   bool average = false;
   int root = 0;
   uint64_t dim0 = 0;            // allgather: local first-dim extent
+  uint64_t shape_hash = 0;      // allreduce/broadcast: FNV over rank+dims
   char* data = nullptr;         // allreduce/broadcast: output buffer
   size_t nbytes = 0;            // 0 for allgather at enqueue time
   // allgather defers output allocation until all ranks' dim0 are known
@@ -458,9 +499,29 @@ struct PendingGen {             // rank-0 per-name negotiation state
   uint64_t nbytes = 0;
   uint64_t root = 0;
   uint64_t row_bytes = 0;       // allgather: agreed nbytes/dim0
+  uint64_t shape_hash = 0;      // allreduce/broadcast: dims digest
   std::vector<uint64_t> dim0s;
   bool mismatch = false;        // op/dtype/size disagreement across ranks
 };
+
+// FNV-1a over ndims + dims[first_dim:]: same byte count in a different
+// shape (e.g. [2,3] vs [3,2]) must NOT silently reinterpret data — the
+// reference errors on shape mismatch (operations.cc ConstructResponse).
+// Allgather hashes from first_dim=1 (dim0 may differ per rank).
+static uint64_t shape_digest(const tensorflow::Tensor& t,
+                             int first_dim = 0) {
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](uint64_t v) {
+    for (int i = 0; i < 8; ++i) {
+      h ^= (v >> (i * 8)) & 0xff;
+      h *= 1099511628211ull;
+    }
+  };
+  mix(static_cast<uint64_t>(t.dims() - first_dim));
+  for (int d = first_dim; d < t.dims(); ++d)
+    mix(static_cast<uint64_t>(t.dim_size(d)));
+  return h;
+}
 
 class Plane {
  public:
@@ -531,6 +592,7 @@ class Plane {
                            &plen);
         if (cfd < 0) { ::close(lfd); ::close(ring_listen); return false; }
         set_nodelay(cfd);
+        set_recv_deadline(cfd, deadline);
         Msg hello;
         int r = -1;
         if (wait_readable(cfd, deadline) && recv_msg(cfd, &hello) &&
@@ -565,6 +627,7 @@ class Plane {
       ctrl0_fd_ = connect_to(coord_host, coord_port, timeout_s);
       if (ctrl0_fd_ < 0) { ::close(ring_listen); return false; }
       set_nodelay(ctrl0_fd_);
+      set_recv_deadline(ctrl0_fd_, deadline);
       if (!send_msg(ctrl0_fd_, &ctrl_send_mu_, HELLO, "",
                     static_cast<uint64_t>(rank_), ring_port)) {
         ::close(ring_listen);
@@ -608,6 +671,12 @@ class Plane {
     if (rank_ == 0 && ::pipe(wake_pipe_) != 0)  // enqueue -> comm wakeup
       return false;
 
+    // bootstrap over: control reads go back to blocking (the comm loop
+    // polls before each recv, so a healthy peer never stalls it)
+    if (ctrl0_fd_ >= 0) clear_recv_deadline(ctrl0_fd_);
+    for (int fd : ctrl_fds_)
+      if (fd >= 0) clear_recv_deadline(fd);
+
     started_ = running_ = true;
     comm_thread_ = std::thread(&Plane::comm_loop, this);
     return true;
@@ -642,41 +711,56 @@ class Plane {
   // TF executor threads land here (ComputeAsync)
   void enqueue(const std::string& name, Entry e) {
     // READY wire encoding: a = op | dtype<<8 | average<<16, b = dim0
-    // (allgather) or root (broadcast), payload = u64 nbytes — the
-    // coordinator validates op/dtype/size/average agreement across ranks
-    // before ordering execution (the reference's ConstructResponse error
-    // checking, operations.cc:198-400)
+    // (allgather) or root (broadcast), payload = u64 nbytes + u64
+    // shape digest — the coordinator validates op/dtype/size/shape/
+    // average agreement across ranks before ordering execution (the
+    // reference's ConstructResponse error checking,
+    // operations.cc:198-400)
     uint32_t a = e.op | (e.dtype << 8) | (e.average ? 1u << 16 : 0);
     uint64_t b = e.op == BROADCAST ? static_cast<uint64_t>(e.root) : e.dim0;
-    uint64_t nbytes = e.nbytes;
+    uint64_t payload[2] = {e.nbytes, e.shape_hash};
     bool dead = false;
+    bool ctrl_lost = false;
     {
-      std::lock_guard<std::mutex> lock(table_mu_);
-      if (!running_) {
-        dead = true;
-      } else {
-        table_[name].push_back(std::move(e));
-      }
-    }
-    if (dead) {
-      e.complete(false, "plane is not running");
-      return;
-    }
-    table_cv_.notify_all();
-    if (rank_ == 0) {
+      // enqueue_order_mu_ makes {table insert, READY emission} atomic
+      // per enqueuing thread: without it, two executor threads
+      // submitting the same tensor_name could interleave between insert
+      // and READY, so the FIFO entry order in table_ would not match
+      // the READY order the coordinator negotiates — pairing an ORDER
+      // with the wrong local Entry.  The comm thread never takes this
+      // mutex, and no completion callback runs inside this scope (TF
+      // may inline-execute another Hvd op from done(), which would
+      // re-enter enqueue and self-deadlock).
+      std::lock_guard<std::mutex> order_lock(enqueue_order_mu_);
       {
-        std::lock_guard<std::mutex> lock(local_ready_mu_);
-        local_ready_.push_back({name, a, b, nbytes});
+        std::lock_guard<std::mutex> lock(table_mu_);
+        if (!running_) {
+          dead = true;
+        } else {
+          table_[name].push_back(std::move(e));
+        }
       }
-      if (wake_pipe_[1] >= 0) {  // wake the comm thread's poll
-        char one = 1;
-        (void)!::write(wake_pipe_[1], &one, 1);
+      if (!dead) {
+        table_cv_.notify_all();
+        if (rank_ == 0) {
+          {
+            std::lock_guard<std::mutex> lock(local_ready_mu_);
+            local_ready_.push_back({name, a, b, payload[0], payload[1]});
+          }
+          if (wake_pipe_[1] >= 0) {  // wake the comm thread's poll
+            char one = 1;
+            (void)!::write(wake_pipe_[1], &one, 1);
+          }
+        } else {
+          ctrl_lost = !send_msg(ctrl0_fd_, &ctrl_send_mu_, READY, name, a,
+                                b, payload, sizeof(payload));
+        }
       }
-    } else {
-      if (!send_msg(ctrl0_fd_, &ctrl_send_mu_, READY, name, a, b,
-                    &nbytes, sizeof(nbytes)))
-        fail_all_pending("control connection to coordinator lost");
     }
+    if (dead)
+      e.complete(false, "plane is not running");
+    else if (ctrl_lost)
+      fail_all_pending("control connection to coordinator lost");
   }
 
  private:
@@ -685,6 +769,7 @@ class Plane {
     uint32_t a;      // op | dtype<<8
     uint64_t b;
     uint64_t nbytes;
+    uint64_t shape_hash;
   };
   struct OrderItem {
     std::string name;
@@ -696,7 +781,7 @@ class Plane {
 
   // ------------------------------------------------------------------ rank 0
   void note_ready(int from_rank, const std::string& name, uint32_t a,
-                  uint64_t b, uint64_t nbytes) {
+                  uint64_t b, uint64_t nbytes, uint64_t shape_hash) {
     uint32_t op = a & 0xff;
     uint32_t dtype = (a >> 8) & 0xff;
     bool average = (a >> 16) & 1;
@@ -713,10 +798,14 @@ class Plane {
       gen->dtype = dtype;
       gen->average = average;
       gen->nbytes = nbytes;
+      gen->shape_hash = shape_hash;
       gen->root = op == BROADCAST ? b : 0;
     } else if (gen->op != op || gen->dtype != dtype ||
                gen->average != average ||
                (op != ALLGATHER && gen->nbytes != nbytes) ||
+               // allreduce/broadcast hash full dims; allgather hashes
+               // dims[1:] (dim0 may differ per rank, inner dims may not)
+               gen->shape_hash != shape_hash ||
                (op == BROADCAST && gen->root != b)) {
       // same name, different op/dtype/size/root across ranks: executing
       // the ring with disagreeing parameters would desync the protocol
@@ -771,7 +860,7 @@ class Plane {
           drained.swap(local_ready_);
         }
         for (auto& lr : drained) note_ready(0, lr.name, lr.a, lr.b,
-                                            lr.nbytes);
+                                            lr.nbytes, lr.shape_hash);
         if (!orders_.empty()) {
           OrderItem item = std::move(orders_.front());
           orders_.pop_front();
@@ -802,11 +891,12 @@ class Plane {
               return;
             }
             if (m.hdr.type == READY) {
-              uint64_t nbytes = 0;
-              if (m.payload.size() >= sizeof(nbytes))
-                std::memcpy(&nbytes, m.payload.data(), sizeof(nbytes));
+              uint64_t meta[2] = {0, 0};  // nbytes, shape digest
+              std::memcpy(meta, m.payload.data(),
+                          std::min(m.payload.size(), sizeof(meta)));
               note_ready(static_cast<int>(i) + 1, m.name,
-                         static_cast<uint32_t>(m.hdr.a), m.hdr.b, nbytes);
+                         static_cast<uint32_t>(m.hdr.a), m.hdr.b, meta[0],
+                         meta[1]);
             }
           }
         }
@@ -863,7 +953,7 @@ class Plane {
     if (item.error) {
       e.complete(false,
                  "tensor '" + item.name + "' was submitted with "
-                 "mismatched op/dtype/size across ranks");
+                 "mismatched op/dtype/size/shape across ranks");
       return;
     }
     bool ok = false;
@@ -1025,6 +1115,7 @@ class Plane {
   int next_fd_ = -1, prev_fd_ = -1;  // the ring
 
   std::mutex api_mu_;
+  std::mutex enqueue_order_mu_;  // serializes {table insert, READY send}
   std::mutex table_mu_;
   std::condition_variable table_cv_;
   std::map<std::string, std::deque<Entry>> table_;
@@ -1129,6 +1220,7 @@ class HvdAllreduceOp : public tf::AsyncOpKernel {
     Entry e;
     e.op = ALLREDUCE;
     e.dtype = static_cast<uint32_t>(code);
+    e.shape_hash = shape_digest(input);
     e.average = average_;
     e.data = const_cast<char*>(output->tensor_data().data());
     e.nbytes = output->tensor_data().size();
@@ -1157,7 +1249,18 @@ class HvdAllgatherOp : public tf::AsyncOpKernel {
     auto& plane = Plane::instance();
     const tf::Tensor& input = ctx->input(0);
     if (plane.size() <= 1) {
-      ctx->set_output(0, input);
+      if (input.dims() == 0) {
+        // the shape fn promises a rank-1 vector; a scalar passthrough
+        // would deliver rank 0 and desync downstream shape inference
+        tf::Tensor* output = nullptr;
+        OP_REQUIRES_OK_ASYNC(
+            ctx, ctx->allocate_output(0, tf::TensorShape({1}), &output),
+            done);
+        std::memcpy(const_cast<char*>(output->tensor_data().data()),
+                    input.tensor_data().data(), input.tensor_data().size());
+      } else {
+        ctx->set_output(0, input);
+      }
       done();
       return;
     }
@@ -1172,6 +1275,9 @@ class HvdAllgatherOp : public tf::AsyncOpKernel {
     Entry e;
     e.op = ALLGATHER;
     e.dtype = static_cast<uint32_t>(code);
+    // dim0 may legitimately differ per rank (allgatherv), but equal ROW
+    // BYTES with different inner dims ([4,2,3] vs [4,3,2]) are rejected
+    e.shape_hash = shape_digest(input, /*first_dim=*/input.dims() ? 1 : 0);
     e.dim0 = input.dims() == 0 ? 1
                                : static_cast<uint64_t>(input.dim_size(0));
     e.nbytes = input.tensor_data().size();  // validation only
@@ -1227,6 +1333,7 @@ class HvdBroadcastOp : public tf::AsyncOpKernel {
     Entry e;
     e.op = BROADCAST;
     e.dtype = static_cast<uint32_t>(code);
+    e.shape_hash = shape_digest(input);
     e.root = root_rank_;
     e.data = const_cast<char*>(output->tensor_data().data());
     e.nbytes = output->tensor_data().size();

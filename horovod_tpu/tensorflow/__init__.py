@@ -406,10 +406,34 @@ def _graph_fused_allreduce(dense, compression, tag):
                      else tf.concat(flats, axis=0))
     buffers = fused + [dense[i] for i in dynamic]
 
-    if _native_graph_ready():
+    # A CUSTOM Compressor (compress/decompress overridden) cannot ride
+    # the native route — its Python compress would be silently skipped
+    # there, a route-dependent behavior difference.  "Stock" is decided
+    # by METHOD IDENTITY, not class identity: a subclass of
+    # NoneCompressor/FP16Compressor that overrides compress must take
+    # the py_function route, where the eager core applies
+    # compress/decompress as documented.  Stock cast compressors are
+    # re-expressed in-graph via wire_dtype.
+    from ..ops.compression import NoneCompressor, _CastCompressor
+
+    def _meth(c, name):
+        f = getattr(c, name, None)
+        return getattr(f, "__func__", f)
+
+    def _stock(base):
+        return (_meth(compression, "compress") is _meth(base, "compress")
+                and _meth(compression, "decompress")
+                is _meth(base, "decompress"))
+
+    wire = getattr(compression, "wire_dtype", None)
+    stock_none = compression is None or _stock(NoneCompressor)
+    stock_cast = wire is not None and _stock(_CastCompressor)
+    # stock check FIRST: a custom compressor must not pay the native
+    # plane's multi-process bootstrap it will never use (the flags are
+    # identical on every rank, so the short-circuit cannot desync ranks)
+    if (stock_none or stock_cast) and _native_graph_ready():
         from . import native
-        wire = getattr(compression, "wire_dtype", None)
-        wire_tf = (None if wire is None
+        wire_tf = (None if not stock_cast
                    else tf.dtypes.as_dtype(np.dtype(wire).name))
         reduced = []
         for j, b in enumerate(buffers):

@@ -47,6 +47,14 @@ class TestSingleProcess:
         np.testing.assert_allclose(
             step(tf.constant([1.0, 2.0])).numpy(), [2.0, 4.0])
 
+    def test_allgather_scalar_size_one_is_vector(self):
+        """At size 1 a scalar input must still come back rank-1: the shape
+        fn promises a vector, and the multi-process path delivers one."""
+        native = _native()
+        out = native.allgather(tf.constant(7.0))
+        assert out.shape.rank == 1
+        np.testing.assert_allclose(out.numpy(), [7.0])
+
     def test_allgather_shape_fn_unknown_first_dim(self):
         native = _native()
 
@@ -248,6 +256,116 @@ class TestMultiProcess:
             assert avg_error, "average-mode mismatch did not raise"
             assert root_error, "out-of-range root did not raise"
             assert after == 3.0
+
+    def test_broadcast_shape_mismatch_errors(self):
+        """Same byte count, different shapes ([2,3] vs [3,2]): the shape
+        digest in the READY payload must surface an error instead of
+        silently delivering reinterpreted data (the reference errors on
+        shape mismatch in ConstructResponse)."""
+        def worker():
+            import os
+            import tensorflow as tf
+            from horovod_tpu.tensorflow import native
+
+            rank = int(os.environ["HVD_PROCESS_ID"])
+            size = int(os.environ["HVD_NUM_PROC"])
+            if not native.available():
+                return "unavailable"
+            assert native.ensure_plane(rank, size)
+            try:
+                bcast_err = False
+                try:
+                    t = tf.zeros([2, 3] if rank == 0 else [3, 2])
+                    native.broadcast(t, root_rank=0, name="shape.clash")
+                except tf.errors.OpError as e:
+                    bcast_err = "mismatched" in str(e)
+                ar_err = False
+                try:
+                    t = tf.zeros([6] if rank == 0 else [2, 3])
+                    native.allreduce(t, name="shape.clash.ar")
+                except tf.errors.OpError as e:
+                    ar_err = "mismatched" in str(e)
+                # allgather: dim0 may differ, inner dims may NOT — equal
+                # row bytes with different inner shapes must be rejected
+                ag_err = False
+                try:
+                    t = tf.zeros([2, 2, 3] if rank == 0 else [4, 3, 2])
+                    native.allgather(t, name="shape.clash.ag")
+                except tf.errors.OpError as e:
+                    ag_err = "mismatched" in str(e)
+                # matching shapes still work after the rejected ones
+                out = native.broadcast(tf.fill([2, 2], float(rank + 1)),
+                                       root_rank=1, name="shape.ok")
+                return bcast_err, ar_err, ag_err, float(out.numpy()[0][0])
+            finally:
+                native.shutdown_plane()
+
+        results = run(worker, num_proc=2, env=_ENV)
+        if results[0] == "unavailable":
+            pytest.skip("libhvd_tf.so unavailable in workers")
+        for bcast_err, ar_err, ag_err, ok_val in results:
+            assert bcast_err, "broadcast shape mismatch did not raise"
+            assert ar_err, "allreduce shape mismatch did not raise"
+            assert ag_err, "allgather inner-shape mismatch did not raise"
+            assert ok_val == 2.0
+
+    def test_custom_compressor_rides_pyfunc_route(self):
+        """A custom Compressor (compress/decompress overridden, no
+        wire_dtype) cannot be re-expressed in-graph: the fused route must
+        fall back to the py_function path where the eager core applies it
+        — not silently skip compression on the native plane."""
+        def worker():
+            import os
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.tensorflow as hvd
+            from horovod_tpu.tensorflow import native
+            from horovod_tpu.ops.compression import Compressor
+
+            hvd.init()
+            if not native.available():
+                hvd.shutdown()
+                return "unavailable"
+            r = int(os.environ["HVD_PROCESS_ID"])
+
+            class Spy(Compressor):
+                calls = []
+
+                @classmethod
+                def compress(cls, tensor):
+                    cls.calls.append("c")
+                    return tensor, None
+
+                @classmethod
+                def decompress(cls, tensor, ctx):
+                    return tensor
+
+            v = tf.Variable([2.0, 4.0])
+            opt = hvd.DistributedOptimizer(
+                __import__("keras").optimizers.SGD(1.0), compression=Spy)
+
+            @tf.function
+            def step():
+                g = tf.constant([1.0, 1.0]) * float(r + 1)
+                opt.apply_gradients([(g, v)])
+                return v
+
+            out = np.asarray(step())
+            # the custom compressor must not pay the native bootstrap it
+            # cannot use: the plane stays down on this route entirely
+            plane_up = native._state["plane_up"]
+            hvd.shutdown()
+            return out.tolist(), len(Spy.calls), bool(plane_up)
+
+        results = run(worker, num_proc=2, env=_ENV)
+        if results[0] == "unavailable":
+            pytest.skip("libhvd_tf.so unavailable in workers")
+        for vals, n_compress_calls, plane_up in results:
+            np.testing.assert_allclose(vals, [0.5, 2.5])
+            assert n_compress_calls > 0, \
+                "custom compressor was skipped on the native route"
+            assert not plane_up, \
+                "native plane bootstrapped for a route that cannot use it"
 
     def test_absent_rank_falls_back_to_pyfunc_everywhere(self):
         """A rank that cannot run the native plane (HVD_TF_NATIVE=0) must
