@@ -243,9 +243,18 @@ static bool write_full(int fd, const void* buf, size_t len) {
   return true;
 }
 
-static bool read_full(int fd, void* buf, size_t len) {
+// deadline == nullptr: retry EAGAIN forever (steady-state comm loop,
+// which polls before reading).  deadline set: give up once it passes —
+// bootstrap must fail at its deadline even when a peer sent a SHORT
+// header and holds the connection open (SO_RCVTIMEO alone cannot end
+// the wait, because EAGAIN is otherwise retried).
+static bool read_full(int fd, void* buf, size_t len,
+                      const std::chrono::steady_clock::time_point*
+                          deadline = nullptr) {
   char* p = static_cast<char*>(buf);
   while (len) {
+    if (deadline && std::chrono::steady_clock::now() >= *deadline)
+      return false;
     ssize_t n = ::recv(fd, p, len, 0);
     if (n <= 0) {
       if (n < 0 && (errno == EINTR || errno == EAGAIN ||
@@ -446,8 +455,10 @@ static bool send_msg(int fd, std::mutex* m, uint32_t type,
   return true;
 }
 
-static bool recv_msg(int fd, Msg* out) {
-  if (!read_full(fd, &out->hdr, sizeof(out->hdr))) return false;
+static bool recv_msg(int fd, Msg* out,
+                     const std::chrono::steady_clock::time_point*
+                         deadline = nullptr) {
+  if (!read_full(fd, &out->hdr, sizeof(out->hdr), deadline)) return false;
   if (out->hdr.magic != kWireMagic || out->hdr.version != kWireVersion) {
     // fail loudly: this is a build/endianness mismatch, not a flaky peer
     std::fprintf(stderr,
@@ -461,11 +472,11 @@ static bool recv_msg(int fd, Msg* out) {
     return false;  // corrupt header
   out->name.resize(out->hdr.name_len);
   if (out->hdr.name_len &&
-      !read_full(fd, &out->name[0], out->hdr.name_len))
+      !read_full(fd, &out->name[0], out->hdr.name_len, deadline))
     return false;
   out->payload.resize(out->hdr.payload_len);
   if (out->hdr.payload_len &&
-      !read_full(fd, out->payload.data(), out->hdr.payload_len))
+      !read_full(fd, out->payload.data(), out->hdr.payload_len, deadline))
     return false;
   return true;
 }
@@ -595,8 +606,8 @@ class Plane {
         set_recv_deadline(cfd, deadline);
         Msg hello;
         int r = -1;
-        if (wait_readable(cfd, deadline) && recv_msg(cfd, &hello) &&
-            hello.hdr.type == HELLO)
+        if (wait_readable(cfd, deadline) &&
+            recv_msg(cfd, &hello, &deadline) && hello.hdr.type == HELLO)
           r = static_cast<int>(hello.hdr.a);
         if (r < 1 || r >= size_ || ctrl_fds_[r] >= 0) {
           // stray client (port scan, health probe), malformed HELLO, or a
@@ -635,7 +646,8 @@ class Plane {
       }
       Msg eps;
       if (!wait_readable(ctrl0_fd_, deadline) ||
-          !recv_msg(ctrl0_fd_, &eps) || eps.hdr.type != ENDPOINTS) {
+          !recv_msg(ctrl0_fd_, &eps, &deadline) ||
+          eps.hdr.type != ENDPOINTS) {
         ::close(ring_listen);
         return false;
       }
@@ -668,8 +680,9 @@ class Plane {
     set_nonblocking(next_fd_);
     set_nonblocking(prev_fd_);
 
-    if (rank_ == 0 && ::pipe(wake_pipe_) != 0)  // enqueue -> comm wakeup
-      return false;
+    if (::pipe(wake_pipe_) != 0)  // enqueue -> comm wakeup (every rank:
+      return false;               // rank 0 drains local_ready_, workers
+                                  // drain the READY outbox)
 
     // bootstrap over: control reads go back to blocking (the comm loop
     // polls before each recv, so a healthy peer never stalls it)
@@ -720,7 +733,6 @@ class Plane {
     uint64_t b = e.op == BROADCAST ? static_cast<uint64_t>(e.root) : e.dim0;
     uint64_t payload[2] = {e.nbytes, e.shape_hash};
     bool dead = false;
-    bool ctrl_lost = false;
     {
       // enqueue_order_mu_ makes {table insert, READY emission} atomic
       // per enqueuing thread: without it, two executor threads
@@ -742,25 +754,23 @@ class Plane {
       }
       if (!dead) {
         table_cv_.notify_all();
-        if (rank_ == 0) {
-          {
-            std::lock_guard<std::mutex> lock(local_ready_mu_);
-            local_ready_.push_back({name, a, b, payload[0], payload[1]});
-          }
-          if (wake_pipe_[1] >= 0) {  // wake the comm thread's poll
-            char one = 1;
-            (void)!::write(wake_pipe_[1], &one, 1);
-          }
-        } else {
-          ctrl_lost = !send_msg(ctrl0_fd_, &ctrl_send_mu_, READY, name, a,
-                                b, payload, sizeof(payload));
+        // No socket I/O in this critical section: a blocking READY
+        // send under enqueue_order_mu_ would stall every executor
+        // thread behind control-plane backpressure.  Both ranks just
+        // append to an ordered outbox the comm thread drains (rank 0:
+        // local_ready_ into note_ready; workers: ready_outbox_ onto
+        // the wire).
+        {
+          std::lock_guard<std::mutex> lock(local_ready_mu_);
+          local_ready_.push_back({name, a, b, payload[0], payload[1]});
+        }
+        if (wake_pipe_[1] >= 0) {  // wake the comm thread's poll
+          char one = 1;
+          (void)!::write(wake_pipe_[1], &one, 1);
         }
       }
     }
-    if (dead)
-      e.complete(false, "plane is not running");
-    else if (ctrl_lost)
-      fail_all_pending("control connection to coordinator lost");
+    if (dead) e.complete(false, "plane is not running");
   }
 
  private:
@@ -901,10 +911,31 @@ class Plane {
           }
         }
       } else {
-        struct pollfd pf = {ctrl0_fd_, POLLIN, 0};
-        int n = ::poll(&pf, 1, 50);
+        // drain the READY outbox first: enqueue stages READYs here so
+        // executor threads never block on control-plane backpressure
+        std::deque<LocalReady> outbox;
+        {
+          std::lock_guard<std::mutex> lock(local_ready_mu_);
+          outbox.swap(local_ready_);
+        }
+        for (auto& lr : outbox) {
+          uint64_t meta[2] = {lr.nbytes, lr.shape_hash};
+          if (!send_msg(ctrl0_fd_, &ctrl_send_mu_, READY, lr.name, lr.a,
+                        lr.b, meta, sizeof(meta))) {
+            if (running_)
+              fail_all_pending("control connection to coordinator lost");
+            return;
+          }
+        }
+        struct pollfd pfs[2] = {{ctrl0_fd_, POLLIN, 0},
+                                {wake_pipe_[0], POLLIN, 0}};
+        int n = ::poll(pfs, 2, 50);
         if (!running_) break;
-        if (n > 0 && (pf.revents & (POLLIN | POLLHUP | POLLERR))) {
+        if (n > 0 && (pfs[1].revents & POLLIN)) {
+          char drain[64];
+          (void)!::read(wake_pipe_[0], drain, sizeof(drain));
+        }
+        if (n > 0 && (pfs[0].revents & (POLLIN | POLLHUP | POLLERR))) {
           Msg m;
           if (!recv_msg(ctrl0_fd_, &m)) {
             if (running_)

@@ -262,6 +262,19 @@ class EagerCoordinator:
         # point: measurement pauses in that window, or cycles run under
         # the OLD config would be scored against the NEW knobs
         self._autotune_pending_adoption = False
+        # Passive scoring state: (flush timestamp, batch bytes) of the
+        # previous non-empty flush. Throughput is scored as
+        # prev_bytes / (this flush's start - prev flush's start) — wall
+        # time the loop measures anyway, the reference ParameterManager's
+        # approach (operations.cc:1553-1555 feeding Update() from cycle
+        # timestamps, no extra synchronization). Under async dispatch
+        # this is exact in steady state: callers block on their handles,
+        # so the inter-flush period IS the time the device (plus the
+        # fixed dispatch path) took for the previous batch. Crucially
+        # the scored regime and the frozen regime are now the SAME
+        # regime — the r3 tuner forced a device sync per scored cycle
+        # and tuned for a world that stopped existing at freeze.
+        self._at_prev_flush = None
         if self._config.autotune and (jax.process_index() == 0):
             from ..utils import autotune as autotune_mod
             self.autotuner = autotune_mod.Autotuner(
@@ -406,39 +419,42 @@ class EagerCoordinator:
             self.plan_cache.put(key, plan)
         self._adopted_this_flush = False
         self._execute(batch, plan)
-        # adoption during this flush also skips scoring: that cycle ran
-        # under the old plan and paid the sync-allgather latency, so it
-        # belongs to neither knob setting
         if (self.autotuner is not None
                 and not self.autotuner.frozen
-                and not self._autotune_pending_adoption
-                and not self._adopted_this_flush):
-            # JAX dispatch is async: without blocking, t1-t0 measures
-            # host dispatch, not collective throughput, and the GP would
-            # tune noise. Only the tuning path pays this sync.
-            for e in batch:
-                result = getattr(e, "result", None)
-                if result is not None:
-                    try:
-                        jax.block_until_ready(result)
-                    except Exception:
-                        pass
+                and not self._autotune_pending_adoption):
             total = sum(_entry_nbytes(e) for e in batch)
-            if self.autotuner.record_cycle(total,
-                                           time.perf_counter() - t0):
-                if self._autotune_defer:
-                    # multi-process: don't apply locally — stage the
-                    # suggestion for the next agreed sync point, or the
-                    # processes' fusion plans would diverge mid-stream
-                    self._proposed_params = (self.autotuner.threshold,
-                                             self.autotuner.cycle_time_ms)
-                    self._autotune_pending_adoption = True
-                else:
-                    # apply the next suggestion (ParameterManager::Tune)
-                    self._config.fusion_threshold = int(
-                        self.autotuner.threshold)
-                    self._config.cycle_time_ms = float(
-                        self.autotuner.cycle_time_ms)
+            prev = self._at_prev_flush
+            self._at_prev_flush = (t0, total)
+            # a pause in traffic is not collective time: a window much
+            # longer than the cycle pacing means the app went idle
+            # between flushes, and scoring it would punish whatever
+            # knobs happened to be live
+            idle_cap = max(10 * self._config.cycle_time_ms / 1000.0, 1.0)
+            if self._adopted_this_flush:
+                # adoption mid-flush: the interval straddles two knob
+                # settings and belongs to neither — restart the window
+                self._at_prev_flush = None
+            elif prev is not None and (t0 - prev[0]) < idle_cap:
+                if self.autotuner.record_cycle(prev[1], t0 - prev[0]):
+                    # knobs move now: the next interval runs under new
+                    # values, so the window restarts
+                    self._at_prev_flush = None
+                    if self._autotune_defer:
+                        # multi-process: don't apply locally — stage the
+                        # suggestion for the next agreed sync point, or
+                        # the processes' fusion plans would diverge
+                        # mid-stream
+                        self._proposed_params = (
+                            self.autotuner.threshold,
+                            self.autotuner.cycle_time_ms)
+                        self._autotune_pending_adoption = True
+                    else:
+                        # apply the next suggestion
+                        # (ParameterManager::Tune)
+                        self._config.fusion_threshold = int(
+                            self.autotuner.threshold)
+                        self._config.cycle_time_ms = float(
+                            self.autotuner.cycle_time_ms)
 
     def _make_plan(self, batch):
         """Group fusable entries (stacked allreduces by dtype/average), one

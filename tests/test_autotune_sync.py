@@ -118,6 +118,107 @@ class TestSyncUnit:
         assert (cfg.fusion_threshold, cfg.cycle_time_ms) == before
 
 
+class TestPassiveScoring:
+    """Round-4 passive scorer: a cycle is scored as its batch bytes over
+    the wall time to the NEXT flush — timestamps the loop already has
+    (the reference ParameterManager's approach, operations.cc:1553-1555,
+    no extra synchronization). Scoring must not force device syncs, and
+    idle gaps between flushes must not be scored."""
+
+    def _attach(self, seed=3):
+        import horovod_tpu
+        from horovod_tpu.utils import autotune as at
+
+        state = horovod_tpu.common.state.global_state()
+        coord, cfg = state.coordinator, state.config
+        saved = (coord.autotuner, coord._autotune_defer,
+                 coord._at_prev_flush, coord._autotune_pending_adoption)
+        tuner = at.Autotuner(cfg, seed=seed)
+        coord.autotuner = tuner
+        coord._autotune_defer = False
+        coord._at_prev_flush = None
+        coord._autotune_pending_adoption = False
+        calls = []
+        orig = tuner.record_cycle
+        tuner.record_cycle = lambda b, d: (calls.append((b, d)),
+                                           orig(b, d))[1]
+
+        def restore():
+            (coord.autotuner, coord._autotune_defer,
+             coord._at_prev_flush,
+             coord._autotune_pending_adoption) = saved
+        return coord, tuner, calls, restore
+
+    def _burst(self, coord, hvd, tag, i):
+        import numpy as np
+        with coord.hold_cycle():
+            h = hvd.allreduce_async(np.ones((2, 8), np.float32),
+                                    average=False, name=f"{tag}.{i}")
+        coord.flush()
+        hvd.synchronize(h)
+
+    def test_scores_previous_cycle_over_inter_flush_window(self, hvd):
+        coord, tuner, calls, restore = self._attach()
+        try:
+            self._burst(coord, hvd, "pas", 0)   # seeds the window
+            self._burst(coord, hvd, "pas", 1)   # scores burst 0
+            assert len(calls) == 1
+            nbytes, dur = calls[0]
+            assert nbytes == 2 * 8 * 4
+            assert 0 < dur < 1.0
+        finally:
+            restore()
+
+    def test_scoring_never_blocks_on_device(self, hvd):
+        import jax
+        coord, tuner, calls, restore = self._attach()
+        blocked = []
+        orig = jax.block_until_ready
+        jax.block_until_ready = lambda x: (blocked.append(1), orig(x))[1]
+        try:
+            self._burst(coord, hvd, "nosync", 0)
+            self._burst(coord, hvd, "nosync", 1)
+            assert len(calls) == 1
+            assert not blocked, \
+                "passive scoring must not force a device sync"
+        finally:
+            jax.block_until_ready = orig
+            restore()
+
+    def test_idle_gap_is_not_scored(self, hvd):
+        import time
+        coord, tuner, calls, restore = self._attach()
+        try:
+            self._burst(coord, hvd, "idle", 0)
+            time.sleep(1.05)                    # > idle cap (1s default)
+            self._burst(coord, hvd, "idle", 1)  # gap: skipped
+            assert calls == []
+            self._burst(coord, hvd, "idle", 2)  # quick: scored
+            assert len(calls) == 1 and calls[0][1] < 1.0
+        finally:
+            restore()
+
+    def test_window_resets_when_knobs_move(self, hvd):
+        from horovod_tpu.utils import autotune as at
+        saved = (at.CYCLES_PER_SAMPLE, at.SAMPLES_PER_STEP)
+        at.CYCLES_PER_SAMPLE = 1
+        at.SAMPLES_PER_STEP = 1
+        coord, tuner, calls, restore = self._attach()
+        try:
+            self._burst(coord, hvd, "move", 0)
+            self._burst(coord, hvd, "move", 1)  # scores + moves knobs
+            assert len(calls) == 1
+            # knob change restarts the window: the next flush seeds, the
+            # one after scores — an interval straddling old/new knobs is
+            # never attributed to either
+            assert coord._at_prev_flush is None
+            self._burst(coord, hvd, "move", 2)
+            assert coord._at_prev_flush is not None
+        finally:
+            restore()
+            (at.CYCLES_PER_SAMPLE, at.SAMPLES_PER_STEP) = saved
+
+
 class TestFreeze:
     def test_freeze_adopts_best_and_stops_scoring(self, hvd):
         """Autotuner.freeze: the reference ParameterManager's converged
