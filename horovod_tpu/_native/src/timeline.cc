@@ -26,11 +26,24 @@ struct Event {
 struct Timeline {
   explicit Timeline(const char* path, int mark_cycles)
       : mark_cycles(mark_cycles != 0),
-        start(std::chrono::steady_clock::now()) {
+        start(std::chrono::steady_clock::now()),
+        // wall-clock epoch at ts=0, sampled in the SAME initializer list
+        // as the monotonic base (fopen below can take ms on a network
+        // filesystem, which would skew every span in the merged view):
+        // merged_timeline aligns these host spans with a jax.profiler
+        // device trace through it (see utils/timeline.py Timeline)
+        epoch_us_at_start(
+            std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::system_clock::now().time_since_epoch())
+                .count()) {
     file = std::fopen(path, "w");
     healthy = file != nullptr;
     if (healthy) {
       std::fputs("[\n", file);
+      std::fprintf(file,
+                   "{\"name\":\"clock_sync\",\"ph\":\"M\",\"pid\":0,"
+                   "\"args\":{\"epoch_us_at_ts0\":%lld}},\n",
+                   static_cast<long long>(epoch_us_at_start));
       writer = std::thread([this] { WriterLoop(); });
     }
   }
@@ -147,6 +160,7 @@ struct Timeline {
   bool healthy = false;
   std::FILE* file = nullptr;
   std::chrono::steady_clock::time_point start;
+  int64_t epoch_us_at_start = 0;
   std::mutex mutex;
   std::condition_variable cv;
   std::deque<Event> queue;

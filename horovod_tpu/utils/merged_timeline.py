@@ -1,0 +1,228 @@
+"""One merged Chrome trace: Horovod host spans + XLA device events.
+
+The Horovod timeline (utils/timeline.py) records what the *framework*
+did — NEGOTIATE_* phases, fusion-buffer memcpys, the collective's
+top-level span.  A ``jax.profiler`` capture records what the *device*
+did — the XLA ops that actually served those collectives.  The
+reference shows both in one view by replaying comm-library activity
+into its timeline from inside op execution
+(horovod/common/timeline.h:80-125, mpi_operations.cc:35-62).  On TPU
+the device events come from XLA's profiler instead, so the equivalent
+is a clock-base merge:
+
+  * the Horovod timeline stamps ``clock_sync`` metadata at creation —
+    the wall-clock epoch at its ts=0 (both writers emit it);
+  * the profiler session's epoch base is ``profile_start_time`` (ns)
+    in the xplane's "Task Environment" plane, and every event in the
+    ``*.trace.json.gz`` XLA writes alongside is in µs since that base;
+  * ``merge()`` re-times both event streams onto the shared epoch
+    clock and writes ONE Chrome-trace JSON: the NEGOTIATE/ALLREDUCE
+    span and the ``hvd.fused_allreduce.*`` device window line up on
+    the same time axis.
+
+Typical use — the ``capture`` context manager drives both recorders::
+
+    hvd.init()                       # HOROVOD_TIMELINE=/tmp/t.json set
+    with merged_timeline.capture("/tmp/merged.json"):
+        ... training steps / eager collectives ...
+    # /tmp/merged.json now holds host spans + device trace
+
+or post-hoc, from artifacts captured separately::
+
+    merged_timeline.merge("/tmp/t.json", "/tmp/jax-trace",
+                          "/tmp/merged.json")
+"""
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import tempfile
+import time
+
+# Horovod lanes are re-numbered into this range so they can never collide
+# with the profiler's pids (xplane pids are small ints too).
+_HVD_PID_BASE = 1_000_000
+
+
+def _load_timeline_events(path):
+    """Parse a (possibly still-open) Horovod timeline file: one JSON
+    object per line, tolerant of the trailing comma / unclosed array the
+    streaming writer leaves behind."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]", "{}]"):
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line of a live file
+    return events
+
+
+def _timeline_epoch_us(events):
+    for e in events:
+        if e.get("name") == "clock_sync":
+            return float(e["args"]["epoch_us_at_ts0"])
+    return None
+
+
+def _find_session_dir(profiler_dir):
+    """The newest plugins/profile/<timestamp>/ under a trace logdir."""
+    sessions = sorted(glob.glob(
+        os.path.join(profiler_dir, "plugins", "profile", "*")))
+    if not sessions:
+        # maybe profiler_dir IS the session dir
+        if glob.glob(os.path.join(profiler_dir, "*.trace.json.gz")):
+            return profiler_dir
+        raise FileNotFoundError(
+            f"no profiler session under {profiler_dir!r} (expected "
+            "plugins/profile/<ts>/ from jax.profiler.start_trace)")
+    return sessions[-1]
+
+
+def _profiler_events(session_dir):
+    paths = sorted(glob.glob(os.path.join(session_dir, "*.trace.json.gz")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz in {session_dir!r}")
+    events = []
+    for path in paths:  # one file per host on multi-host captures
+        with gzip.open(path, "rt") as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    return events
+
+
+def _profiler_epoch_us_from_xplane(session_dir):
+    """profile_start_time (epoch ns) from the xplane's Task Environment
+    plane.  Parsed via tensorflow's bundled proto when available; the
+    caller may instead supply the base explicitly (capture() samples the
+    wall clock around start_trace, which matches to ~100 µs)."""
+    paths = glob.glob(os.path.join(session_dir, "*.xplane.pb"))
+    if not paths:
+        return None
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        return None
+    space = xplane_pb2.XSpace()
+    with open(paths[0], "rb") as f:
+        space.ParseFromString(f.read())
+    for plane in space.planes:
+        names = {i: m.name for i, m in plane.stat_metadata.items()}
+        for stat in plane.stats:
+            if names.get(stat.metadata_id) == "profile_start_time":
+                return stat.uint64_value / 1e3  # ns -> us
+    return None
+
+
+def merge(timeline_path, profiler_dir, out_path, profiler_epoch_us=None,
+          profiler_epoch_us_fallback=None):
+    """Merge a Horovod timeline file and a jax.profiler capture into one
+    Chrome-trace JSON on a shared clock base.
+
+    The profiler session base (epoch µs at the profiler's ts=0) is
+    resolved in precision order: explicit ``profiler_epoch_us`` if
+    given, then the xplane protobuf's ``profile_start_time`` (exact),
+    then ``profiler_epoch_us_fallback`` (capture()'s wall-clock sample,
+    ~100 µs off plus start_trace setup latency).  Returns the merged
+    event count.
+    """
+    hvd_events = _load_timeline_events(timeline_path)
+    hvd_epoch = _timeline_epoch_us(hvd_events)
+    if hvd_epoch is None:
+        raise ValueError(
+            f"{timeline_path!r} has no clock_sync metadata — it was "
+            "written by a pre-round-4 timeline; re-capture it")
+    session = _find_session_dir(profiler_dir)
+    prof_events = _profiler_events(session)
+    if profiler_epoch_us is None:
+        profiler_epoch_us = _profiler_epoch_us_from_xplane(session)
+    if profiler_epoch_us is None:
+        profiler_epoch_us = profiler_epoch_us_fallback
+    if profiler_epoch_us is None:
+        raise ValueError(
+            "cannot determine the profiler session's epoch base: no "
+            "xplane.pb/tensorflow proto — pass profiler_epoch_us "
+            "(capture() records a fallback automatically)")
+
+    base = min(hvd_epoch, profiler_epoch_us)
+    merged = []
+    for e in hvd_events:
+        e = dict(e)
+        e["pid"] = _HVD_PID_BASE + int(e.get("pid", 0))
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            e["args"] = {"name": "hvd: " + e["args"]["name"]}
+        if "ts" in e:
+            e["ts"] = e["ts"] + (hvd_epoch - base)
+        merged.append(e)
+    # one named lane for the framework clock_sync/cycle markers (pid 0)
+    merged.insert(0, {"name": "process_name", "ph": "M",
+                      "pid": _HVD_PID_BASE,
+                      "args": {"name": "hvd: coordinator"}})
+    shift = profiler_epoch_us - base
+    for e in prof_events:
+        if "ts" in e:
+            e = dict(e)
+            e["ts"] = e["ts"] + shift
+        merged.append(e)
+    with open(out_path, "w") as f:
+        json.dump({"displayTimeUnit": "ns", "traceEvents": merged}, f)
+    return len(merged)
+
+
+def _drain_timeline(timeline, timeout_s=5.0):
+    """Poll the writer thread's queue until it has drained (bounded) —
+    a fixed sleep would silently truncate the merged file's tail under
+    writer backlog."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if timeline.pending() == 0:
+                break
+        except Exception:
+            break
+        time.sleep(0.02)
+    time.sleep(0.05)  # the final event's write+flush is not queue-visible
+
+
+@contextlib.contextmanager
+def capture(out_path, profiler_dir=None):
+    """Run a ``jax.profiler`` trace over the context and, on exit, merge
+    it with the live Horovod timeline into ``out_path``.
+
+    Requires this process to OWN an active timeline (rank 0 with
+    ``HOROVOD_TIMELINE=<file>`` at ``hvd.init()`` — the timeline is
+    rank-0-only, reference operations.cc:986-994); raises otherwise.
+    If the traced body raises, the profiler is stopped but no merge is
+    attempted, so the body's exception propagates unmasked.
+    """
+    import jax
+
+    from ..common import state
+
+    st = state.global_state()
+    timeline = getattr(st.coordinator, "timeline", None)
+    if timeline is None:
+        raise RuntimeError(
+            "merged_timeline.capture needs an active timeline on THIS "
+            "process: set HOROVOD_TIMELINE=<file> before hvd.init() and "
+            "call capture() on rank 0 (the timeline is rank-0-only)")
+    timeline_path = st.config.timeline_filename
+    if profiler_dir is None:
+        profiler_dir = tempfile.mkdtemp(prefix="hvd-merged-trace-")
+    epoch_us = time.time_ns() / 1e3
+    jax.profiler.start_trace(profiler_dir)
+    ok = False
+    try:
+        yield
+        ok = True
+    finally:
+        jax.profiler.stop_trace()
+        if ok:
+            _drain_timeline(timeline)
+            merge(timeline_path, profiler_dir, out_path,
+                  profiler_epoch_us_fallback=epoch_us)

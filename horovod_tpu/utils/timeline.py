@@ -50,11 +50,18 @@ class Timeline:
         self._lock = threading.Lock()
         self._healthy = True
         self._start = time.monotonic()
+        # wall-clock epoch at ts=0, sampled at the same instant as the
+        # monotonic base: merged_timeline uses it to place these host
+        # spans on the same absolute clock as a jax.profiler device
+        # trace (whose xplane carries profile_start_time in epoch ns)
+        epoch_us_at_ts0 = time.time_ns() // 1000
         self._file = open(filename, "w")
         self._file.write("[\n")
         self._thread = threading.Thread(target=self._writer_loop, daemon=True,
                                         name="hvd-timeline-writer")
         self._thread.start()
+        self._emit({"name": "clock_sync", "ph": "M", "pid": 0,
+                    "args": {"epoch_us_at_ts0": epoch_us_at_ts0}})
 
     @property
     def enabled(self):
@@ -100,6 +107,11 @@ class Timeline:
         if self._mark_cycles:
             self._emit({"name": CYCLE_START, "ph": "i", "pid": 0, "s": "g",
                         "ts": self._ts_us()})
+
+    def pending(self):
+        """Events queued but not yet written (drain-polling for readers
+        of the live file, e.g. merged_timeline.capture)."""
+        return self._queue.qsize()
 
     def _writer_loop(self):
         while True:
@@ -156,6 +168,9 @@ class NativeTimeline:
 
     def mark_cycle_start(self):
         self._lib.hvd_timeline_cycle(self._ptr)
+
+    def pending(self):
+        return int(self._lib.hvd_timeline_pending(self._ptr))
 
     def close(self):
         if self._ptr:

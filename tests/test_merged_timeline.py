@@ -1,0 +1,102 @@
+"""Merged timeline: Horovod host spans and the XLA device trace in ONE
+Chrome-trace file on a shared clock base (the reference shows comm
+activity inside op execution in one view — timeline.h:80-125,
+mpi_operations.cc:35-62; here the device half comes from jax.profiler).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def hvd_timeline(monkeypatch, tmp_path):
+    path = tmp_path / "timeline.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    import horovod_tpu as hvd_mod
+    hvd_mod.init()
+    yield hvd_mod, path
+    hvd_mod.shutdown()
+
+
+class TestMergedTimeline:
+    def test_capture_writes_one_file_with_both_event_classes(
+            self, hvd_timeline, tmp_path):
+        hvd, _ = hvd_timeline
+        from horovod_tpu.utils import merged_timeline
+
+        out = tmp_path / "merged.json"
+        with merged_timeline.capture(str(out),
+                                     profiler_dir=str(tmp_path / "prof")):
+            for i in range(3):
+                hvd.allreduce(np.full((8, 4), float(i)),
+                              average=False, name=f"mt.grad{i}")
+
+        data = json.loads(out.read_text())
+        events = data["traceEvents"]
+        # host spans from the Horovod timeline…
+        hvd_spans = [e for e in events
+                     if e.get("pid", 0) >= merged_timeline._HVD_PID_BASE]
+        names = {e.get("name") for e in hvd_spans}
+        assert "NEGOTIATE_ALLREDUCE" in names
+        assert "ALLREDUCE" in names
+        # …and complete profiler events from the XLA capture, in the
+        # same file, on re-based non-negative timestamps
+        prof_events = [e for e in events
+                       if e.get("pid", 0) < merged_timeline._HVD_PID_BASE
+                       and e.get("ph") == "X"]
+        assert prof_events, "no device-trace events in the merged file"
+        assert all(e["ts"] >= 0 for e in events if "ts" in e)
+
+    def test_clocks_align_within_the_session(self, hvd_timeline, tmp_path):
+        """The collective's host span and the profiler's window must land
+        in the same neighborhood — not seconds apart — or the merge's
+        clock math is wrong."""
+        hvd, _ = hvd_timeline
+        from horovod_tpu.utils import merged_timeline
+
+        out = tmp_path / "merged.json"
+        with merged_timeline.capture(str(out),
+                                     profiler_dir=str(tmp_path / "prof")):
+            hvd.allreduce(np.ones((8, 4)), average=False, name="mt.align")
+
+        events = json.loads(out.read_text())["traceEvents"]
+        hvd_ts = [e["ts"] for e in events
+                  if e.get("pid", 0) >= merged_timeline._HVD_PID_BASE
+                  and "ts" in e]
+        prof_ts = [e["ts"] for e in events
+                   if e.get("pid", 0) < merged_timeline._HVD_PID_BASE
+                   and "ts" in e]
+        assert hvd_ts and prof_ts
+        # both streams cover one short session: their extents overlap to
+        # within a generous second
+        assert min(hvd_ts) < max(prof_ts) + 1e6
+        assert min(prof_ts) < max(hvd_ts) + 1e6
+
+    def test_capture_without_timeline_raises(self, hvd, tmp_path):
+        from horovod_tpu.utils import merged_timeline
+        with pytest.raises(RuntimeError, match="HOROVOD_TIMELINE"):
+            with merged_timeline.capture(str(tmp_path / "m.json")):
+                pass
+
+    def test_body_exception_propagates_unmasked(self, hvd_timeline,
+                                                tmp_path):
+        """A failure inside the traced body must surface as itself — not
+        be replaced by a merge error over the aborted capture."""
+        hvd, _ = hvd_timeline
+        from horovod_tpu.utils import merged_timeline
+
+        with pytest.raises(ZeroDivisionError):
+            with merged_timeline.capture(str(tmp_path / "m.json")):
+                1 / 0
+        assert not (tmp_path / "m.json").exists()
+
+    def test_merge_rejects_presync_timeline(self, tmp_path):
+        from horovod_tpu.utils import merged_timeline
+        old = tmp_path / "old.json"
+        old.write_text('[\n{"name": "ALLREDUCE", "ph": "B", "pid": 1, '
+                       '"ts": 5},\n')
+        with pytest.raises(ValueError, match="clock_sync"):
+            merged_timeline.merge(str(old), str(tmp_path),
+                                  str(tmp_path / "m.json"))
