@@ -44,6 +44,8 @@ struct Timeline {
                    "{\"name\":\"clock_sync\",\"ph\":\"M\",\"pid\":0,"
                    "\"args\":{\"epoch_us_at_ts0\":%lld}},\n",
                    static_cast<long long>(epoch_us_at_start));
+      // flush now: a live-file merge may read before any event does
+      std::fflush(file);
       writer = std::thread([this] { WriterLoop(); });
     }
   }

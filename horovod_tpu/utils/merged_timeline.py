@@ -212,7 +212,8 @@ def capture(out_path, profiler_dir=None):
             "process: set HOROVOD_TIMELINE=<file> before hvd.init() and "
             "call capture() on rank 0 (the timeline is rank-0-only)")
     timeline_path = st.config.timeline_filename
-    if profiler_dir is None:
+    own_dir = profiler_dir is None
+    if own_dir:
         profiler_dir = tempfile.mkdtemp(prefix="hvd-merged-trace-")
     epoch_us = time.time_ns() / 1e3
     jax.profiler.start_trace(profiler_dir)
@@ -226,3 +227,8 @@ def capture(out_path, profiler_dir=None):
             _drain_timeline(timeline)
             merge(timeline_path, profiler_dir, out_path,
                   profiler_epoch_us_fallback=epoch_us)
+            if own_dir:
+                # the raw dump (xplane.pb + trace.json.gz) is merged into
+                # out_path; keep only user-supplied dirs
+                import shutil
+                shutil.rmtree(profiler_dir, ignore_errors=True)
