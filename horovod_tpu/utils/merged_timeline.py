@@ -223,12 +223,15 @@ def capture(out_path, profiler_dir=None):
         ok = True
     finally:
         jax.profiler.stop_trace()
-        if ok:
-            _drain_timeline(timeline)
-            merge(timeline_path, profiler_dir, out_path,
-                  profiler_epoch_us_fallback=epoch_us)
+        try:
+            if ok:
+                _drain_timeline(timeline)
+                merge(timeline_path, profiler_dir, out_path,
+                      profiler_epoch_us_fallback=epoch_us)
+        finally:
             if own_dir:
-                # the raw dump (xplane.pb + trace.json.gz) is merged into
-                # out_path; keep only user-supplied dirs
+                # the raw dump (xplane.pb + trace.json.gz) either merged
+                # into out_path or belongs to an aborted capture; only
+                # user-supplied dirs are kept either way
                 import shutil
                 shutil.rmtree(profiler_dir, ignore_errors=True)

