@@ -37,10 +37,18 @@ examples:
 	    --epochs 1 --steps-per-epoch 4 --checkpoint-dir /tmp/hvd-ci-torch-ckpt
 	$(CPU_ENV) $(PY) examples/keras_mnist.py \
 	    --epochs 1 --steps-per-epoch 4 --checkpoint-dir /tmp/hvd-ci-keras-ckpt
+	# 2-process launch: LearningRateWarmupCallback's ramp is identity at
+	# size 1, so the warmup/schedule recipe is exercised across ranks
+	$(CPU_ENV) PYTHONPATH=. $(PY) bin/hvdrun -np 2 $(PY) \
+	    examples/keras_mnist_advanced.py --epochs 3 --steps-per-epoch 3 \
+	    --val-steps 1 --warmup-epochs 2 \
+	    --checkpoint-dir /tmp/hvd-ci-keras-adv-ckpt
+	$(CPU_ENV) $(PY) examples/mxnet_mnist.py --epochs 1 --steps-per-epoch 4
 	$(CPU_MESH) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 ci: native test examples
 
 clean:
 	rm -rf build dist *.egg-info /tmp/hvd-ci-imagenet-ckpt \
-	    /tmp/hvd-ci-torch-ckpt /tmp/hvd-ci-keras-ckpt
+	    /tmp/hvd-ci-torch-ckpt /tmp/hvd-ci-keras-ckpt \
+	    /tmp/hvd-ci-keras-adv-ckpt

@@ -1,0 +1,129 @@
+"""Distributed MXNet (gluon) MNIST — reference examples/mxnet_mnist.py
+parity: ``DistributedTrainer`` (allreduce gradient exchange instead of
+kvstore push/pull), ``broadcast_parameters`` with deferred-init support,
+rank-sharded data, final accuracy evaluation.
+
+mxnet is an optional dependency of this framework (the CI image cannot
+install it — docs/testing.md records the recipe); without it this
+example exits 0 with a SKIP line so ``make examples`` stays green while
+still executing the full script wherever mxnet is present.
+
+Usage:
+    python examples/mxnet_mnist.py --epochs 2
+    bin/hvdrun -np 2 python examples/mxnet_mnist.py --epochs 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+try:
+    import mxnet as mx
+    from mxnet import autograd, gluon
+except ImportError:
+    print("SKIP: mxnet is not installed (see docs/testing.md for the "
+          "real-mxnet verification recipe)")
+    sys.exit(0)
+
+import horovod_tpu.mxnet as hvd
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="horovod_tpu mxnet MNIST")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--data", default=None, help="path to mnist .npz")
+    p.add_argument("--steps-per-epoch", type=int, default=None)
+    return p.parse_args()
+
+
+def load_data(path, n=4096, n_val=1024):
+    if path and os.path.exists(path):
+        with np.load(path) as d:
+            return ((d["x_train"].astype(np.float32)[:, None] / 255.0,
+                     d["y_train"].astype(np.float32)),
+                    (d["x_test"].astype(np.float32)[:, None] / 255.0,
+                     d["y_test"].astype(np.float32)))
+    rng = np.random.RandomState(0)
+    return ((rng.rand(n, 1, 28, 28).astype(np.float32),
+             rng.randint(0, 10, n).astype(np.float32)),
+            (rng.rand(n_val, 1, 28, 28).astype(np.float32),
+             rng.randint(0, 10, n_val).astype(np.float32)))
+
+
+def conv_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(channels=20, kernel_size=5,
+                            activation="relu"))
+    net.add(gluon.nn.MaxPool2D(pool_size=2, strides=2))
+    net.add(gluon.nn.Conv2D(channels=50, kernel_size=5,
+                            activation="relu"))
+    net.add(gluon.nn.MaxPool2D(pool_size=2, strides=2))
+    net.add(gluon.nn.Flatten())
+    net.add(gluon.nn.Dense(512, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    return net
+
+
+def evaluate(model, X, Y, batch_size, ctx):
+    correct = total = 0
+    for i in range(0, len(X) - batch_size + 1, batch_size):
+        data = mx.nd.array(X[i:i + batch_size], ctx=ctx)
+        out = model(data).asnumpy()
+        correct += int((out.argmax(1) == Y[i:i + batch_size]).sum())
+        total += batch_size
+    return correct / max(1, total)
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    ctx = mx.cpu(hvd.local_rank())
+    world = hvd.size()
+
+    (X, Y), (Xv, Yv) = load_data(args.data)
+    X, Y = X[hvd.rank()::world], Y[hvd.rank()::world]
+    steps = args.steps_per_epoch or max(1, len(X) // args.batch_size)
+
+    model = conv_net()
+    model.hybridize()
+    model.initialize(mx.init.Xavier(), ctx=ctx)
+    # touch one forward so deferred shapes exist, then broadcast rank 0's
+    # weights (deferred-init parameters broadcast via their _init_impl
+    # hook — reference mxnet/__init__.py:106-150)
+    model(mx.nd.zeros((1, 1, 28, 28), ctx=ctx))
+    hvd.broadcast_parameters(model.collect_params(), root_rank=0)
+
+    trainer = hvd.DistributedTrainer(
+        model.collect_params(), "sgd",
+        {"learning_rate": args.lr * world, "momentum": args.momentum})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        running = 0.0
+        for step in range(steps):
+            lo = (step * args.batch_size) % max(1, len(X) - args.batch_size)
+            data = mx.nd.array(X[lo:lo + args.batch_size], ctx=ctx)
+            label = mx.nd.array(Y[lo:lo + args.batch_size], ctx=ctx)
+            with autograd.record():
+                loss = loss_fn(model(data), label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            running += float(loss.mean().asscalar())
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {running / steps:.4f}")
+
+    acc = evaluate(model, Xv, Yv, args.batch_size, ctx)
+    if hvd.rank() == 0:
+        print(f"Validation accuracy: {acc:.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

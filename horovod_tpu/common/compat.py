@@ -1,0 +1,23 @@
+"""Version shims for the narrow JAX surface whose location moved.
+
+The framework targets current JAX (top-level ``jax.shard_map``,
+stabilized in 0.6). One real-world env needs older JAX: real-mxnet
+integration (docs/testing.md) — mxnet 1.9.1 is frozen at numpy<1.24,
+which caps jax at 0.4.x, where shard_map still lived in
+``jax.experimental.shard_map``. Resolving it here keeps every caller on
+one import and the modern path free of try/except noise.
+"""
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.6 (numpy<1.24 envs, e.g. real-mxnet)
+    from jax.experimental.shard_map import shard_map as _sm_old
+
+    def shard_map(f, mesh, *, axis_names=None, **kw):
+        """Translate the modern ``axis_names`` kwarg (manual axes) to the
+        old API's complement kwarg ``auto`` (axes left to GSPMD)."""
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _sm_old(f, mesh, **kw)

@@ -51,6 +51,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common import compat
 from ..common import hvd_logging as log
 from ..common import state as state_mod
 from ..common.exceptions import (DuplicateNameError, MismatchError,
@@ -764,7 +765,7 @@ class EagerCoordinator:
 
         @jax.jit
         def f(x):
-            return jax.shard_map(
+            return compat.shard_map(
                 lambda s: lax.psum(s, axis), mesh=mesh,
                 in_specs=P(axis), out_specs=P(axis))(x)
         return f
@@ -779,7 +780,7 @@ class EagerCoordinator:
                 idx = lax.axis_index(axis)
                 masked = jnp.where(idx == root, s, jnp.zeros_like(s))
                 return lax.psum(masked, axis)
-            return jax.shard_map(shard_fn, mesh=mesh, in_specs=P(axis),
+            return compat.shard_map(shard_fn, mesh=mesh, in_specs=P(axis),
                                  out_specs=P(axis))(x)
         return f
 

@@ -31,6 +31,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common import compat
+
 PROC_AXIS = "proc"
 
 
@@ -83,7 +85,7 @@ class ProcessCollectiveEngine:
             def body(s):
                 out = lax.psum(s[0], PROC_AXIS)
                 return out / self.nproc if average else out
-            return jax.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
+            return compat.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
                                  out_specs=P())(x)
         return f
 
@@ -97,7 +99,7 @@ class ProcessCollectiveEngine:
                 idx = lax.axis_index(PROC_AXIS)
                 masked = jnp.where(idx == root, s[0], jnp.zeros_like(s[0]))
                 return lax.psum(masked, PROC_AXIS)
-            return jax.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
+            return compat.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
                                  out_specs=P())(x)
         return f
 
@@ -116,7 +118,7 @@ class ProcessCollectiveEngine:
                 out = lax.psum_scatter(s[0], PROC_AXIS,
                                        scatter_dimension=0, tiled=True)
                 return out / self.nproc if average else out
-            return jax.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
+            return compat.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
                                  out_specs=P(PROC_AXIS))(x)
         return f
 
@@ -129,7 +131,7 @@ class ProcessCollectiveEngine:
             def body(s):
                 return lax.all_to_all(s[0], PROC_AXIS, split_axis=0,
                                       concat_axis=0, tiled=True)
-            return jax.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
+            return compat.shard_map(body, mesh=mesh, in_specs=P(PROC_AXIS),
                                  out_specs=P(PROC_AXIS))(x)
         return f
 

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..common import compat
+
 
 def gpipe(stage_fn, microbatches, axis_name="pp"):
     """Run ``stage_fn`` as one stage of a GPipe pipeline. Must be called
@@ -257,7 +259,7 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
     param_specs_tree = pipeline_param_specs(pparams)
     opt_specs = trainer_mod.opt_state_specs(tx, pparams, param_specs_tree)
     batch_spec = P(dp_axis, None)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(compat.shard_map(
         step, mesh=mesh, axis_names=frozenset({dp_axis, pp_axis}),
         in_specs=(param_specs_tree, opt_specs, batch_spec),
         out_specs=(param_specs_tree, opt_specs, P())))

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .common import compat
 from . import optim
 from .ops.compression import Compression
 
@@ -105,7 +106,7 @@ def make_data_parallel_step(loss_fn, tx, mesh, axis_name=None,
     # default: shard every leaf's leading dim over the worker axis.
     # Replicated leaves (e.g. an rng key) use P().
     batch_spec = batch_specs if batch_specs is not None else P(axis)
-    step = jax.shard_map(
+    step = compat.shard_map(
         per_worker, mesh=mesh,
         in_specs=(P(), P(), batch_spec),
         out_specs=(P(), P(), P()))
