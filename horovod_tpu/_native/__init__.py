@@ -99,6 +99,28 @@ def build(force=False):
     return _LIB_PATH
 
 
+_PLANE_LIB_PATH = os.path.join(_DIR, "libhvd_plane.so")
+
+
+def build_plane(force=False):
+    """Compile the framework-agnostic collective plane's C API
+    (libhvd_plane.so from plane.h + plane_c.cc — no TensorFlow linkage;
+    the ctypes surface for the torch frontend)."""
+    src_dir = os.path.join(_DIR, "src")
+    sources = [os.path.join(src_dir, "plane_c.cc")]
+    deps = sources + [os.path.join(src_dir, "plane.h")]
+    if not force and os.path.exists(_PLANE_LIB_PATH):
+        if os.path.getmtime(_PLANE_LIB_PATH) >= max(
+                os.path.getmtime(d) for d in deps):
+            return _PLANE_LIB_PATH
+    # -fvisibility=hidden: the inline Plane singleton must not merge
+    # with libhvd_tf.so's copy when both are loaded (plane.h note)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-fvisibility=hidden", "-o", _PLANE_LIB_PATH] + sources
+    subprocess.run(cmd, check=True)
+    return _PLANE_LIB_PATH
+
+
 _TF_LIB_PATH = os.path.join(_DIR, "libhvd_tf.so")
 
 
@@ -110,11 +132,14 @@ def build_tf(force=False):
     import tensorflow as tf  # deferred: TF is an optional frontend dep
 
     src = os.path.join(_DIR, "src", "tf_ops.cc")
+    deps = [src, os.path.join(_DIR, "src", "plane.h")]
     if not force and os.path.exists(_TF_LIB_PATH):
-        if os.path.getmtime(_TF_LIB_PATH) >= os.path.getmtime(src):
+        if os.path.getmtime(_TF_LIB_PATH) >= max(
+                os.path.getmtime(d) for d in deps):
             return _TF_LIB_PATH
-    cmd = (["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o",
-            _TF_LIB_PATH, src]
+    # -fvisibility=hidden: see build_plane (shared singleton hazard)
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-pthread",
+            "-fvisibility=hidden", "-o", _TF_LIB_PATH, src]
            + tf.sysconfig.get_compile_flags()
            + tf.sysconfig.get_link_flags())
     subprocess.run(cmd, check=True)

@@ -1,0 +1,1137 @@
+// Framework-agnostic native collective plane: rank-0-negotiated TCP
+// control plane + TCP ring data plane, shared by the TensorFlow custom
+// ops (tf_ops.cc) and the C API for other frontends (plane_c.cc, used
+// by horovod_tpu.torch). Factored out of tf_ops.cc in round 4 — see the
+// architecture comment there; below the kernel layer nothing is
+// TensorFlow-specific.
+//
+// Implementation-in-header: each .so that needs the plane compiles it
+// in (internal linkage); the two .so files are never both initialized
+// in one process (each frontend owns its own rendezvous port).
+
+#ifndef HVD_PLANE_H_
+#define HVD_PLANE_H_
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// Builds compile with -fvisibility=hidden so the inline Plane singleton
+// is NOT exported as STB_GNU_UNIQUE — without that, a process loading
+// both libhvd_tf.so and libhvd_plane.so would have the dynamic loader
+// merge the two frontends' "separate" planes into one singleton,
+// defeating the per-frontend rendezvous-port design. Only the extern
+// "C" API is exported, via this macro.
+#define HVDPLANE_EXPORT __attribute__((visibility("default")))
+
+namespace hvdplane {
+
+// ---------------------------------------------------------------------------
+// dtypes
+// ---------------------------------------------------------------------------
+
+enum DType : uint32_t { F32 = 0, F64, I32, I64, F16, BF16 };
+
+static size_t elem_size(uint32_t d) {
+  switch (d) {
+    case F32: case I32: return 4;
+    case F64: case I64: return 8;
+    default: return 2;  // F16, BF16
+  }
+}
+
+// fp16/bf16 <-> fp32 bit conversions (no Eigen dependency; the software-sum
+// role of the reference's half.cc HalfBits2Float/Float2HalfBits)
+static inline float bf16_to_f32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu))
+    return static_cast<uint16_t>((bits >> 16) | 0x40u);  // NaN stays NaN
+  // round-to-nearest-even on the dropped 16 bits (would carry a
+  // low-mantissa NaN into the exponent and yield Inf without the guard)
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+static inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal (mant * 2^-24): normalize
+      int shift = 0;
+      while (!(mant & 0x400u)) { mant <<= 1; ++shift; }
+      mant &= 0x3ffu;
+      // one normalization shift is implied by the hidden bit: biased
+      // exponent is 113 - shift (112 - shift would halve every value)
+      bits = sign | ((113 - shift) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000u | (mant << 13);  // inf/nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static inline uint16_t f32_to_f16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (exp >= 31) {  // overflow or inf/nan
+    if (((bits >> 23) & 0xff) == 0xff && mant)
+      return static_cast<uint16_t>(sign | 0x7e00u);  // nan
+    return static_cast<uint16_t>(sign | 0x7c00u);    // inf
+  }
+  if (exp <= 0) {  // subnormal or zero
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t rounded = (mant + (1u << (shift - 1)) - 1 +
+                        ((mant >> shift) & 1)) >> shift;
+    return static_cast<uint16_t>(sign | rounded);
+  }
+  // round mantissa to 10 bits, nearest-even
+  uint32_t rounded = mant + 0xfff + ((mant >> 13) & 1);
+  if (rounded & 0x800000u) { rounded = 0; ++exp; if (exp >= 31)
+      return static_cast<uint16_t>(sign | 0x7c00u); }
+  return static_cast<uint16_t>(sign | (exp << 10) | (rounded >> 13));
+}
+
+// dst[i] += src[i] over `count` elements of dtype `d`
+static void reduce_add(char* dst, const char* src, size_t count, uint32_t d) {
+  switch (d) {
+    case F32: {
+      auto* a = reinterpret_cast<float*>(dst);
+      auto* b = reinterpret_cast<const float*>(src);
+      for (size_t i = 0; i < count; ++i) a[i] += b[i];
+      break;
+    }
+    case F64: {
+      auto* a = reinterpret_cast<double*>(dst);
+      auto* b = reinterpret_cast<const double*>(src);
+      for (size_t i = 0; i < count; ++i) a[i] += b[i];
+      break;
+    }
+    case I32: {
+      auto* a = reinterpret_cast<int32_t*>(dst);
+      auto* b = reinterpret_cast<const int32_t*>(src);
+      for (size_t i = 0; i < count; ++i) a[i] += b[i];
+      break;
+    }
+    case I64: {
+      auto* a = reinterpret_cast<int64_t*>(dst);
+      auto* b = reinterpret_cast<const int64_t*>(src);
+      for (size_t i = 0; i < count; ++i) a[i] += b[i];
+      break;
+    }
+    case F16: {
+      auto* a = reinterpret_cast<uint16_t*>(dst);
+      auto* b = reinterpret_cast<const uint16_t*>(src);
+      for (size_t i = 0; i < count; ++i)
+        a[i] = f32_to_f16(f16_to_f32(a[i]) + f16_to_f32(b[i]));
+      break;
+    }
+    case BF16: {
+      auto* a = reinterpret_cast<uint16_t*>(dst);
+      auto* b = reinterpret_cast<const uint16_t*>(src);
+      for (size_t i = 0; i < count; ++i)
+        a[i] = f32_to_bf16(bf16_to_f32(a[i]) + bf16_to_f32(b[i]));
+      break;
+    }
+  }
+}
+
+static void scale_buf(char* dst, size_t count, uint32_t d, double factor) {
+  switch (d) {
+    case F32: {
+      auto* a = reinterpret_cast<float*>(dst);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < count; ++i) a[i] *= f;
+      break;
+    }
+    case F64: {
+      auto* a = reinterpret_cast<double*>(dst);
+      for (size_t i = 0; i < count; ++i) a[i] *= factor;
+      break;
+    }
+    case F16: {
+      auto* a = reinterpret_cast<uint16_t*>(dst);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < count; ++i)
+        a[i] = f32_to_f16(f16_to_f32(a[i]) * f);
+      break;
+    }
+    case BF16: {
+      auto* a = reinterpret_cast<uint16_t*>(dst);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < count; ++i)
+        a[i] = f32_to_bf16(bf16_to_f32(a[i]) * f);
+      break;
+    }
+    default: break;  // integer average is not defined; sum only
+  }
+}
+
+// ---------------------------------------------------------------------------
+// sockets
+// ---------------------------------------------------------------------------
+
+static bool write_full(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR || errno == EAGAIN ||
+                    errno == EWOULDBLOCK)) {
+        struct pollfd pf = {fd, POLLOUT, 0};
+        ::poll(&pf, 1, 1000);
+        continue;
+      }
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// deadline == nullptr: retry EAGAIN forever (steady-state comm loop,
+// which polls before reading).  deadline set: give up once it passes —
+// bootstrap must fail at its deadline even when a peer sent a SHORT
+// header and holds the connection open (SO_RCVTIMEO alone cannot end
+// the wait, because EAGAIN is otherwise retried).
+static bool read_full(int fd, void* buf, size_t len,
+                      const std::chrono::steady_clock::time_point*
+                          deadline = nullptr) {
+  char* p = static_cast<char*>(buf);
+  while (len) {
+    if (deadline && std::chrono::steady_clock::now() >= *deadline)
+      return false;
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR || errno == EAGAIN ||
+                    errno == EWOULDBLOCK)) {
+        struct pollfd pf = {fd, POLLIN, 0};
+        ::poll(&pf, 1, 1000);
+        continue;
+      }
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// simultaneous send-to-next / recv-from-prev with poll-driven partial IO —
+// the full-duplex ring step (blocking both directions independently would
+// deadlock once a segment exceeds the socket buffers). A half-open peer
+// (powered-off host, silent partition) never delivers a FIN, so lack of
+// progress for IO_STALL_MS fails the exchange instead of spinning forever
+// — the error then propagates through fail_all_pending and every pending
+// op surfaces it (the reference's stall-shutdown role for the data plane).
+static const int IO_STALL_MS = 120000;
+
+static bool exchange(int send_fd, const char* sbuf, size_t slen,
+                     int recv_fd, char* rbuf, size_t rlen) {
+  size_t soff = 0, roff = 0;
+  int idle_ms = 0;
+  while (soff < slen || roff < rlen) {
+    struct pollfd pf[2];
+    int n = 0, si = -1, ri = -1;
+    if (soff < slen) { pf[n] = {send_fd, POLLOUT, 0}; si = n++; }
+    if (roff < rlen) { pf[n] = {recv_fd, POLLIN, 0}; ri = n++; }
+    int pr = ::poll(pf, n, 1000);
+    if (pr < 0 && errno != EINTR) return false;
+    if (pr == 0) {
+      idle_ms += 1000;
+      if (idle_ms >= IO_STALL_MS) return false;
+      continue;
+    }
+    idle_ms = 0;
+    if (si >= 0 && (pf[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t w = ::send(send_fd, sbuf + soff, slen - soff, MSG_NOSIGNAL);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR)
+        return false;
+      if (w > 0) soff += static_cast<size_t>(w);
+    }
+    if (ri >= 0 && (pf[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(recv_fd, rbuf + roff, rlen - roff, 0);
+      if (r == 0) return false;
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR)
+        return false;
+      if (r > 0) roff += static_cast<size_t>(r);
+    }
+  }
+  return true;
+}
+
+static void set_nodelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+static void set_nonblocking(int fd) {
+  // poll-driven partial IO in exchange(); write_full/read_full spin-poll
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+static int listen_any(uint16_t* port_out, int backlog) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(*port_out);
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(fd, backlog) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  *port_out = ntohs(addr.sin_port);
+  return fd;
+}
+
+static int connect_to(const std::string& host, uint16_t port,
+                      double timeout_s) {
+  struct addrinfo hints, *res = nullptr;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%u", port);
+  if (::getaddrinfo(host.c_str(), portstr, &hints, &res) != 0 || !res)
+    return -1;
+  int fd = -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(
+                      static_cast<int64_t>(timeout_s * 1000));
+  while (std::chrono::steady_clock::now() < deadline) {
+    fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0 &&
+        ::connect(fd, res->ai_addr, res->ai_addrlen) == 0)
+      break;
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+    ::usleep(100000);  // coordinator may not be listening yet: retry
+  }
+  ::freeaddrinfo(res);
+  return fd;
+}
+
+// ---------------------------------------------------------------------------
+// wire messages (control plane)
+// ---------------------------------------------------------------------------
+
+enum MsgType : uint32_t { HELLO = 1, ENDPOINTS, READY, ORDER, ORDER_ERR };
+
+// wait until fd is readable or the deadline passes (bootstrap only — a
+// worker that never joins must fail the init instead of hanging the job)
+static bool wait_readable(int fd, std::chrono::steady_clock::time_point
+                                      deadline) {
+  for (;;) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now()).count();
+    if (left <= 0) return false;
+    struct pollfd pf = {fd, POLLIN, 0};
+    int n = ::poll(&pf, 1, static_cast<int>(std::min<long long>(left, 500)));
+    if (n > 0) return true;
+    if (n < 0 && errno != EINTR) return false;
+  }
+}
+
+// Every header starts with a magic word (endianness-sensitive: a
+// byte-swapped peer produces a non-matching value) and a wire version.
+// A HELLO from a mismatched build or a heterogeneous-endianness host is
+// rejected at bootstrap instead of being interpreted as garbage ranks.
+static constexpr uint32_t kWireMagic = 0x48564454;  // "HVDT"
+static constexpr uint32_t kWireVersion = 2;         // bump on MsgHdr change
+
+// Bound a socket's blocking reads by the bootstrap deadline: a peer that
+// sends a short/older header (fewer bytes than MsgHdr) must time the
+// read out instead of stalling recv_msg inside the accept loop forever —
+// wait_readable only guarantees the FIRST byte, not the whole header.
+static void set_recv_deadline(int fd,
+                              std::chrono::steady_clock::time_point
+                                  deadline) {
+  auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+      deadline - std::chrono::steady_clock::now()).count();
+  if (left < 1) left = 1;
+  struct timeval tv;
+  tv.tv_sec = left / 1000;
+  tv.tv_usec = (left % 1000) * 1000;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+static void clear_recv_deadline(int fd) {
+  struct timeval tv = {0, 0};  // back to blocking (comm loop polls first)
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+struct MsgHdr {         // fixed header; name + payload follow
+  uint32_t magic;
+  uint32_t version;
+  uint32_t type;
+  uint32_t name_len;
+  uint64_t a;           // HELLO: rank      READY/ORDER: op
+  uint64_t b;           // HELLO: ring port READY: dim0|root  ORDER: root
+  uint64_t payload_len; // ENDPOINTS: table  ORDER(allgather): P x u64 dim0
+};
+
+struct Msg {
+  MsgHdr hdr;
+  std::string name;
+  std::vector<char> payload;
+};
+
+static bool send_msg(int fd, std::mutex* m, uint32_t type,
+                     const std::string& name, uint64_t a, uint64_t b,
+                     const void* payload = nullptr, size_t plen = 0) {
+  MsgHdr h = {kWireMagic, kWireVersion, type,
+              static_cast<uint32_t>(name.size()), a, b,
+              static_cast<uint64_t>(plen)};
+  std::lock_guard<std::mutex> lock(*m);
+  if (!write_full(fd, &h, sizeof(h))) return false;
+  if (!name.empty() && !write_full(fd, name.data(), name.size()))
+    return false;
+  if (plen && !write_full(fd, payload, plen)) return false;
+  return true;
+}
+
+static bool recv_msg(int fd, Msg* out,
+                     const std::chrono::steady_clock::time_point*
+                         deadline = nullptr) {
+  if (!read_full(fd, &out->hdr, sizeof(out->hdr), deadline)) return false;
+  if (out->hdr.magic != kWireMagic || out->hdr.version != kWireVersion) {
+    // fail loudly: this is a build/endianness mismatch, not a flaky peer
+    std::fprintf(stderr,
+                 "[hvd_tf] control-plane peer speaks wire magic=%08x "
+                 "version=%u (want %08x/%u) — mismatched build or "
+                 "endianness; rejecting connection\n",
+                 out->hdr.magic, out->hdr.version, kWireMagic, kWireVersion);
+    return false;
+  }
+  if (out->hdr.name_len > (1u << 20) || out->hdr.payload_len > (1u << 30))
+    return false;  // corrupt header
+  out->name.resize(out->hdr.name_len);
+  if (out->hdr.name_len &&
+      !read_full(fd, &out->name[0], out->hdr.name_len, deadline))
+    return false;
+  out->payload.resize(out->hdr.payload_len);
+  if (out->hdr.payload_len &&
+      !read_full(fd, out->payload.data(), out->hdr.payload_len, deadline))
+    return false;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// the plane
+// ---------------------------------------------------------------------------
+
+enum CollOp : uint32_t { ALLREDUCE = 0, ALLGATHER, BROADCAST };
+
+
+struct Entry {
+  uint32_t op;
+  uint32_t dtype;
+  bool average = false;
+  int root = 0;
+  uint64_t dim0 = 0;            // allgather: local first-dim extent
+  uint64_t shape_hash = 0;      // dims digest (allgather: dims[1:] only)
+  char* data = nullptr;         // allreduce/broadcast: output buffer
+  size_t nbytes = 0;            // 0 for allgather at enqueue time
+  // allgather: the local block and its row size; output allocation is
+  // deferred until all ranks' dim0 are known, through the
+  // frontend-supplied callback (TF allocates an op output, the C API a
+  // malloc'd buffer)
+  const char* gather_src = nullptr;
+  size_t gather_src_bytes = 0;
+  uint64_t row_bytes = 0;
+  std::function<char*(uint64_t total_rows)> gather_alloc;
+  std::function<void(bool, const std::string&)> complete;
+};
+
+struct PendingGen {             // rank-0 per-name negotiation state
+  std::vector<bool> present;
+  size_t count = 0;
+  uint32_t op = 0;
+  uint32_t dtype = 0;
+  bool average = false;
+  uint64_t nbytes = 0;
+  uint64_t root = 0;
+  uint64_t row_bytes = 0;       // allgather: agreed nbytes/dim0
+  uint64_t shape_hash = 0;      // allreduce/broadcast: dims digest
+  std::vector<uint64_t> dim0s;
+  bool mismatch = false;        // op/dtype/size disagreement across ranks
+};
+
+// FNV-1a over ndims + dims[first_dim:]: same byte count in a different
+// shape (e.g. [2,3] vs [3,2]) must NOT silently reinterpret data — the
+// reference errors on shape mismatch (operations.cc ConstructResponse).
+// FNV-1a over ndims + dims[first_dim:]: same byte count in a different
+// shape (e.g. [2,3] vs [3,2]) must NOT silently reinterpret data — the
+// reference errors on shape mismatch (operations.cc ConstructResponse).
+// Allgather hashes from first_dim=1 (dim0 may differ per rank).
+static uint64_t shape_digest_dims(int ndims, const int64_t* dims) {
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](uint64_t v) {
+    for (int i = 0; i < 8; ++i) {
+      h ^= (v >> (i * 8)) & 0xff;
+      h *= 1099511628211ull;
+    }
+  };
+  mix(static_cast<uint64_t>(ndims));
+  for (int d = 0; d < ndims; ++d) mix(static_cast<uint64_t>(dims[d]));
+  return h;
+}
+
+class Plane {
+ public:
+  static Plane& instance() {
+    static Plane p;
+    return p;
+  }
+
+  bool init(int rank, int size, const std::string& coord_host,
+            uint16_t coord_port, double timeout_s) {
+    std::lock_guard<std::mutex> lock(api_mu_);
+    if (started_) return running_ && rank == rank_ && size == size_;
+    bool ok = init_inner(rank, size, coord_host, coord_port, timeout_s);
+    if (!ok) close_member_fds();  // partial bootstrap must not leak fds
+    return ok;
+  }
+
+ private:
+  void close_member_fds() {
+    if (ctrl0_fd_ >= 0) ::close(ctrl0_fd_);
+    ctrl0_fd_ = -1;
+    for (int fd : ctrl_fds_)
+      if (fd >= 0) ::close(fd);
+    ctrl_fds_.clear();
+    if (next_fd_ >= 0) ::close(next_fd_);
+    if (prev_fd_ >= 0) ::close(prev_fd_);
+    next_fd_ = prev_fd_ = -1;
+    for (int& fd : wake_pipe_)
+      if (fd >= 0) { ::close(fd); fd = -1; }
+  }
+
+  bool init_inner(int rank, int size, const std::string& coord_host,
+                  uint16_t coord_port, double timeout_s) {
+    rank_ = rank;
+    size_ = size;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(
+                        static_cast<int64_t>(timeout_s * 1000));
+    if (size_ <= 1) { started_ = running_ = true; return true; }
+
+    // 1. ring listener first, so HELLO can announce its port
+    uint16_t ring_port = 0;
+    int ring_listen = listen_any(&ring_port, 2);
+    if (ring_listen < 0) return false;
+
+    std::vector<std::string> hosts(size_);
+    std::vector<uint16_t> ports(size_);
+
+    if (rank_ == 0) {
+      uint16_t cp = coord_port;
+      int lfd = listen_any(&cp, size_);
+      if (lfd < 0 || cp != coord_port) { ::close(ring_listen); return false; }
+      hosts[0] = coord_host;
+      ports[0] = ring_port;
+      ctrl_fds_.assign(size_, -1);
+      int joined = 0;
+      while (joined < size_ - 1) {
+        // bounded wait: a worker that never joins (failed native build,
+        // HVD_TF_NATIVE=0 on its host) must fail THIS init too, so every
+        // rank falls back to the py_function route together
+        if (!wait_readable(lfd, deadline)) {
+          ::close(lfd); ::close(ring_listen);
+          return false;
+        }
+        struct sockaddr_in peer;
+        socklen_t plen = sizeof(peer);
+        int cfd = ::accept(lfd, reinterpret_cast<struct sockaddr*>(&peer),
+                           &plen);
+        if (cfd < 0) { ::close(lfd); ::close(ring_listen); return false; }
+        set_nodelay(cfd);
+        set_recv_deadline(cfd, deadline);
+        Msg hello;
+        int r = -1;
+        if (wait_readable(cfd, deadline) &&
+            recv_msg(cfd, &hello, &deadline) && hello.hdr.type == HELLO)
+          r = static_cast<int>(hello.hdr.a);
+        if (r < 1 || r >= size_ || ctrl_fds_[r] >= 0) {
+          // stray client (port scan, health probe), malformed HELLO, or a
+          // duplicate rank from a double-launched worker: drop the
+          // connection, keep waiting for the real ranks until deadline
+          ::close(cfd);
+          continue;
+        }
+        char ip[INET_ADDRSTRLEN];
+        ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+        hosts[r] = ip;
+        ports[r] = static_cast<uint16_t>(hello.hdr.b);
+        ctrl_fds_[r] = cfd;
+        ++joined;
+      }
+      ::close(lfd);
+      // endpoint table: "host:port\n" per rank
+      std::string table;
+      for (int r = 0; r < size_; ++r)
+        table += hosts[r] + ":" + std::to_string(ports[r]) + "\n";
+      for (int r = 1; r < size_; ++r)
+        if (!send_msg(ctrl_fds_[r], &ctrl_send_mu_, ENDPOINTS, "", 0, 0,
+                      table.data(), table.size())) {
+          ::close(ring_listen);
+          return false;
+        }
+    } else {
+      ctrl0_fd_ = connect_to(coord_host, coord_port, timeout_s);
+      if (ctrl0_fd_ < 0) { ::close(ring_listen); return false; }
+      set_nodelay(ctrl0_fd_);
+      set_recv_deadline(ctrl0_fd_, deadline);
+      if (!send_msg(ctrl0_fd_, &ctrl_send_mu_, HELLO, "",
+                    static_cast<uint64_t>(rank_), ring_port)) {
+        ::close(ring_listen);
+        return false;
+      }
+      Msg eps;
+      if (!wait_readable(ctrl0_fd_, deadline) ||
+          !recv_msg(ctrl0_fd_, &eps, &deadline) ||
+          eps.hdr.type != ENDPOINTS) {
+        ::close(ring_listen);
+        return false;
+      }
+      std::string table(eps.payload.begin(), eps.payload.end());
+      size_t pos = 0;
+      for (int r = 0; r < size_; ++r) {
+        size_t nl = table.find('\n', pos);
+        size_t colon = table.rfind(':', nl);
+        hosts[r] = table.substr(pos, colon - pos);
+        ports[r] = static_cast<uint16_t>(
+            std::stoi(table.substr(colon + 1, nl - colon - 1)));
+        pos = nl + 1;
+      }
+    }
+
+    // 2. ring: connect to successor, accept from predecessor.  Connect
+    // first (everyone's listener already exists), then accept.
+    int next = (rank_ + 1) % size_;
+    next_fd_ = connect_to(hosts[next], ports[next], timeout_s);
+    if (next_fd_ < 0) { ::close(ring_listen); return false; }
+    set_nodelay(next_fd_);
+    if (!wait_readable(ring_listen, deadline)) {
+      ::close(ring_listen);
+      return false;
+    }
+    prev_fd_ = ::accept(ring_listen, nullptr, nullptr);
+    ::close(ring_listen);
+    if (prev_fd_ < 0) return false;
+    set_nodelay(prev_fd_);
+    set_nonblocking(next_fd_);
+    set_nonblocking(prev_fd_);
+
+    if (::pipe(wake_pipe_) != 0)  // enqueue -> comm wakeup (every rank:
+      return false;               // rank 0 drains local_ready_, workers
+                                  // drain the READY outbox)
+
+    // bootstrap over: control reads go back to blocking (the comm loop
+    // polls before each recv, so a healthy peer never stalls it)
+    if (ctrl0_fd_ >= 0) clear_recv_deadline(ctrl0_fd_);
+    for (int fd : ctrl_fds_)
+      if (fd >= 0) clear_recv_deadline(fd);
+
+    started_ = running_ = true;
+    comm_thread_ = std::thread(&Plane::comm_loop, this);
+    return true;
+  }
+
+ public:
+  void shutdown() {
+    std::lock_guard<std::mutex> lock(api_mu_);
+    if (!started_) return;
+    started_ = false;
+    running_ = false;
+    table_cv_.notify_all();
+    // shutting the sockets down unblocks any poll/recv in the comm thread
+    if (ctrl0_fd_ >= 0) ::shutdown(ctrl0_fd_, SHUT_RDWR);
+    for (int fd : ctrl_fds_)
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+    if (next_fd_ >= 0) ::shutdown(next_fd_, SHUT_RDWR);
+    if (prev_fd_ >= 0) ::shutdown(prev_fd_, SHUT_RDWR);
+    if (wake_pipe_[1] >= 0) {
+      char one = 1;
+      (void)!::write(wake_pipe_[1], &one, 1);
+    }
+    if (comm_thread_.joinable()) comm_thread_.join();
+    close_member_fds();
+    fail_all_pending("plane shut down");
+  }
+
+  bool initialized() const { return running_; }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // TF executor threads land here (ComputeAsync)
+  void enqueue(const std::string& name, Entry e) {
+    // READY wire encoding: a = op | dtype<<8 | average<<16, b = dim0
+    // (allgather) or root (broadcast), payload = u64 nbytes + u64
+    // shape digest — the coordinator validates op/dtype/size/shape/
+    // average agreement across ranks before ordering execution (the
+    // reference's ConstructResponse error checking,
+    // operations.cc:198-400)
+    uint32_t a = e.op | (e.dtype << 8) | (e.average ? 1u << 16 : 0);
+    uint64_t b = e.op == BROADCAST ? static_cast<uint64_t>(e.root) : e.dim0;
+    uint64_t payload[2] = {e.nbytes, e.shape_hash};
+    bool dead = false;
+    {
+      // enqueue_order_mu_ makes {table insert, READY emission} atomic
+      // per enqueuing thread: without it, two executor threads
+      // submitting the same tensor_name could interleave between insert
+      // and READY, so the FIFO entry order in table_ would not match
+      // the READY order the coordinator negotiates — pairing an ORDER
+      // with the wrong local Entry.  The comm thread never takes this
+      // mutex, and no completion callback runs inside this scope (TF
+      // may inline-execute another Hvd op from done(), which would
+      // re-enter enqueue and self-deadlock).
+      std::lock_guard<std::mutex> order_lock(enqueue_order_mu_);
+      {
+        std::lock_guard<std::mutex> lock(table_mu_);
+        if (!running_) {
+          dead = true;
+        } else {
+          table_[name].push_back(std::move(e));
+        }
+      }
+      if (!dead) {
+        table_cv_.notify_all();
+        // No socket I/O in this critical section: a blocking READY
+        // send under enqueue_order_mu_ would stall every executor
+        // thread behind control-plane backpressure.  Both ranks just
+        // append to an ordered outbox the comm thread drains (rank 0:
+        // local_ready_ into note_ready; workers: ready_outbox_ onto
+        // the wire).
+        {
+          std::lock_guard<std::mutex> lock(local_ready_mu_);
+          local_ready_.push_back({name, a, b, payload[0], payload[1]});
+        }
+        if (wake_pipe_[1] >= 0) {  // wake the comm thread's poll
+          char one = 1;
+          (void)!::write(wake_pipe_[1], &one, 1);
+        }
+      }
+    }
+    if (dead) e.complete(false, "plane is not running");
+  }
+
+ private:
+  struct LocalReady {
+    std::string name;
+    uint32_t a;      // op | dtype<<8
+    uint64_t b;
+    uint64_t nbytes;
+    uint64_t shape_hash;
+  };
+  struct OrderItem {
+    std::string name;
+    uint32_t op;
+    uint64_t root;
+    std::vector<uint64_t> dim0s;
+    bool error = false;
+  };
+
+  // ------------------------------------------------------------------ rank 0
+  void note_ready(int from_rank, const std::string& name, uint32_t a,
+                  uint64_t b, uint64_t nbytes, uint64_t shape_hash) {
+    uint32_t op = a & 0xff;
+    uint32_t dtype = (a >> 8) & 0xff;
+    bool average = (a >> 16) & 1;
+    auto& gens = negotiating_[name];
+    PendingGen* gen = nullptr;
+    for (auto& g : gens)
+      if (!g.present[from_rank]) { gen = &g; break; }
+    if (!gen) {
+      gens.emplace_back();
+      gen = &gens.back();
+      gen->present.assign(size_, false);
+      gen->dim0s.assign(size_, 0);
+      gen->op = op;
+      gen->dtype = dtype;
+      gen->average = average;
+      gen->nbytes = nbytes;
+      gen->shape_hash = shape_hash;
+      gen->root = op == BROADCAST ? b : 0;
+    } else if (gen->op != op || gen->dtype != dtype ||
+               gen->average != average ||
+               (op != ALLGATHER && gen->nbytes != nbytes) ||
+               // allreduce/broadcast hash full dims; allgather hashes
+               // dims[1:] (dim0 may differ per rank, inner dims may not)
+               gen->shape_hash != shape_hash ||
+               (op == BROADCAST && gen->root != b)) {
+      // same name, different op/dtype/size/root across ranks: executing
+      // the ring with disagreeing parameters would desync the protocol
+      // or broadcast from a root some ranks never asked for — surface an
+      // error on every rank instead
+      gen->mismatch = true;
+    }
+    if (op == ALLGATHER && b > 0) {
+      // rows may differ per rank but the row SIZE must agree, or each
+      // rank computes different block offsets and the ring desyncs
+      uint64_t row = nbytes / b;
+      if (nbytes % b) gen->mismatch = true;
+      if (gen->row_bytes == 0) gen->row_bytes = row;
+      else if (gen->row_bytes != row) gen->mismatch = true;
+    }
+    gen->present[from_rank] = true;
+    ++gen->count;
+    if (op == ALLGATHER) gen->dim0s[from_rank] = b;
+    while (!gens.empty() && gens.front().count ==
+           static_cast<size_t>(size_)) {
+      PendingGen done = std::move(gens.front());
+      gens.pop_front();
+      emit_order(name, done);
+    }
+    if (gens.empty()) negotiating_.erase(name);
+  }
+
+  void emit_order(const std::string& name, const PendingGen& gen) {
+    const char* payload = nullptr;
+    size_t plen = 0;
+    if (gen.op == ALLGATHER && !gen.mismatch) {
+      payload = reinterpret_cast<const char*>(gen.dim0s.data());
+      plen = gen.dim0s.size() * sizeof(uint64_t);
+    }
+    uint32_t type = gen.mismatch ? ORDER_ERR : ORDER;
+    for (int r = 1; r < size_; ++r)
+      if (!send_msg(ctrl_fds_[r], &ctrl_send_mu_, type, name, gen.op,
+                    gen.root, payload, plen)) {
+        fail_all_pending("control connection to a worker lost");
+        return;
+      }
+    orders_.push_back({name, gen.op, gen.root, gen.dim0s, gen.mismatch});
+  }
+
+  // --------------------------------------------------------------- comm loop
+  void comm_loop() {
+    while (running_) {
+      if (rank_ == 0) {
+        std::deque<LocalReady> drained;
+        {
+          std::lock_guard<std::mutex> lock(local_ready_mu_);
+          drained.swap(local_ready_);
+        }
+        for (auto& lr : drained) note_ready(0, lr.name, lr.a, lr.b,
+                                            lr.nbytes, lr.shape_hash);
+        if (!orders_.empty()) {
+          OrderItem item = std::move(orders_.front());
+          orders_.pop_front();
+          execute(item);
+          continue;
+        }
+        // poll worker control sockets for READY + the enqueue wake pipe
+        // (without the pipe, rank 0 being the last rank to enqueue would
+        // cost up to a full poll period of dead latency per collective)
+        std::vector<struct pollfd> pfds;
+        for (int r = 1; r < size_; ++r)
+          pfds.push_back({ctrl_fds_[r], POLLIN, 0});
+        pfds.push_back({wake_pipe_[0], POLLIN, 0});
+        int n = ::poll(pfds.data(), pfds.size(), 50);
+        if (!running_) break;
+        if (n > 0) {
+          if (pfds.back().revents & POLLIN) {
+            char drain[64];
+            (void)!::read(wake_pipe_[0], drain, sizeof(drain));
+          }
+          for (size_t i = 0; i + 1 < pfds.size(); ++i) {
+            if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR)))
+              continue;
+            Msg m;
+            if (!recv_msg(pfds[i].fd, &m)) {
+              if (running_)
+                fail_all_pending("lost connection to a worker");
+              return;
+            }
+            if (m.hdr.type == READY) {
+              uint64_t meta[2] = {0, 0};  // nbytes, shape digest
+              std::memcpy(meta, m.payload.data(),
+                          std::min(m.payload.size(), sizeof(meta)));
+              note_ready(static_cast<int>(i) + 1, m.name,
+                         static_cast<uint32_t>(m.hdr.a), m.hdr.b, meta[0],
+                         meta[1]);
+            }
+          }
+        }
+      } else {
+        // drain the READY outbox first: enqueue stages READYs here so
+        // executor threads never block on control-plane backpressure
+        std::deque<LocalReady> outbox;
+        {
+          std::lock_guard<std::mutex> lock(local_ready_mu_);
+          outbox.swap(local_ready_);
+        }
+        for (auto& lr : outbox) {
+          uint64_t meta[2] = {lr.nbytes, lr.shape_hash};
+          if (!send_msg(ctrl0_fd_, &ctrl_send_mu_, READY, lr.name, lr.a,
+                        lr.b, meta, sizeof(meta))) {
+            if (running_)
+              fail_all_pending("control connection to coordinator lost");
+            return;
+          }
+        }
+        struct pollfd pfs[2] = {{ctrl0_fd_, POLLIN, 0},
+                                {wake_pipe_[0], POLLIN, 0}};
+        int n = ::poll(pfs, 2, 50);
+        if (!running_) break;
+        if (n > 0 && (pfs[1].revents & POLLIN)) {
+          char drain[64];
+          (void)!::read(wake_pipe_[0], drain, sizeof(drain));
+        }
+        if (n > 0 && (pfs[0].revents & (POLLIN | POLLHUP | POLLERR))) {
+          Msg m;
+          if (!recv_msg(ctrl0_fd_, &m)) {
+            if (running_)
+              fail_all_pending("lost connection to coordinator");
+            return;
+          }
+          if (m.hdr.type == ORDER || m.hdr.type == ORDER_ERR) {
+            OrderItem item;
+            item.name = m.name;
+            item.op = static_cast<uint32_t>(m.hdr.a);
+            item.root = m.hdr.b;
+            item.error = m.hdr.type == ORDER_ERR;
+            if (item.op == ALLGATHER && !item.error) {
+              item.dim0s.resize(size_);
+              std::memcpy(item.dim0s.data(), m.payload.data(),
+                          std::min(m.payload.size(),
+                                   item.dim0s.size() * sizeof(uint64_t)));
+            }
+            execute(item);
+          }
+        }
+      }
+    }
+  }
+
+  Entry take_entry(const std::string& name) {
+    // the local entry exists by construction: READY is sent only after the
+    // table insert, and ORDER only fires after every rank's READY — but a
+    // slow enqueue thread may still be between insert and notify, so wait.
+    std::unique_lock<std::mutex> lock(table_mu_);
+    table_cv_.wait_for(lock, std::chrono::seconds(60), [&] {
+      auto it = table_.find(name);
+      return (it != table_.end() && !it->second.empty()) || !running_;
+    });
+    auto it = table_.find(name);
+    if (it == table_.end() || it->second.empty()) return Entry{};
+    Entry e = std::move(it->second.front());
+    it->second.pop_front();
+    if (it->second.empty()) table_.erase(it);
+    return e;
+  }
+
+  void execute(const OrderItem& item) {
+    Entry e = take_entry(item.name);
+    if (!e.complete) return;  // shutdown race
+    if (item.error) {
+      e.complete(false,
+                 "tensor '" + item.name + "' was submitted with "
+                 "mismatched op/dtype/size/shape across ranks");
+      return;
+    }
+    bool ok = false;
+    std::string err;
+    switch (e.op) {
+      case ALLREDUCE:
+        ok = ring_allreduce(&e, &err);
+        break;
+      case ALLGATHER:
+        ok = ring_allgather(&e, item.dim0s, &err);
+        break;
+      case BROADCAST:
+        ok = ring_broadcast(&e, static_cast<int>(item.root), &err);
+        break;
+    }
+    e.complete(ok, err);
+    if (!ok) fail_all_pending(err);
+  }
+
+  bool ring_allreduce(Entry* e, std::string* err) {
+    const int P = size_;
+    size_t esz = elem_size(e->dtype);
+    size_t n = e->nbytes / esz;
+    if (n == 0) return true;
+    // element-aligned segments; segment i owns [off[i], off[i+1])
+    std::vector<size_t> seg_off(P + 1, 0);
+    for (int i = 0; i < P; ++i)
+      seg_off[i + 1] = seg_off[i] + n / P + (static_cast<size_t>(i) < n % P);
+    size_t max_seg = (n / P + 1) * esz;
+    std::vector<char> scratch(max_seg);
+    char* buf = e->data;
+    // reduce-scatter: after P-1 steps, segment (rank+1)%P holds the full sum
+    for (int step = 0; step < P - 1; ++step) {
+      int s = (rank_ - step + P) % P;
+      int r = (rank_ - step - 1 + P) % P;
+      size_t slen = (seg_off[s + 1] - seg_off[s]) * esz;
+      size_t rlen = (seg_off[r + 1] - seg_off[r]) * esz;
+      if (!exchange(next_fd_, buf + seg_off[s] * esz, slen, prev_fd_,
+                    scratch.data(), rlen)) {
+        *err = "ring exchange failed (reduce-scatter)";
+        return false;
+      }
+      reduce_add(buf + seg_off[r] * esz, scratch.data(),
+                 seg_off[r + 1] - seg_off[r], e->dtype);
+    }
+    // allgather: circulate the completed segments
+    for (int step = 0; step < P - 1; ++step) {
+      int s = (rank_ - step + 1 + P) % P;
+      int r = (rank_ - step + P) % P;
+      size_t slen = (seg_off[s + 1] - seg_off[s]) * esz;
+      size_t rlen = (seg_off[r + 1] - seg_off[r]) * esz;
+      if (!exchange(next_fd_, buf + seg_off[s] * esz, slen, prev_fd_,
+                    buf + seg_off[r] * esz, rlen)) {
+        *err = "ring exchange failed (allgather)";
+        return false;
+      }
+    }
+    if (e->average) scale_buf(buf, n, e->dtype, 1.0 / P);
+    return true;
+  }
+
+  bool ring_allgather(Entry* e, const std::vector<uint64_t>& dim0s,
+                      std::string* err) {
+    const int P = size_;
+    uint64_t total_rows = 0;
+    for (int r = 0; r < P; ++r) total_rows += dim0s[r];
+    char* buf = e->gather_alloc ? e->gather_alloc(total_rows) : nullptr;
+    if (!buf) {
+      *err = "allgather output allocation failed";
+      return false;
+    }
+    size_t row_bytes = e->row_bytes;
+    std::vector<size_t> off(P + 1, 0);
+    for (int r = 0; r < P; ++r)
+      off[r + 1] = off[r] + static_cast<size_t>(dim0s[r]) * row_bytes;
+    // own block into place
+    std::memcpy(buf + off[rank_], e->gather_src, e->gather_src_bytes);
+    // circulate: after P-1 steps every rank holds every block
+    for (int step = 0; step < P - 1; ++step) {
+      int s = (rank_ - step + P) % P;
+      int r = (rank_ - step - 1 + P) % P;
+      if (!exchange(next_fd_, buf + off[s], off[s + 1] - off[s], prev_fd_,
+                    buf + off[r], off[r + 1] - off[r])) {
+        *err = "ring exchange failed (allgatherv)";
+        return false;
+      }
+    }
+    return true;
+  }
+
+  bool ring_broadcast(Entry* e, int root, std::string* err) {
+    if (e->nbytes == 0) return true;
+    const int P = size_;
+    int next = (rank_ + 1) % P;
+    if (rank_ == root) {
+      if (next != root &&
+          !exchange(next_fd_, e->data, e->nbytes, -1, nullptr, 0)) {
+        *err = "broadcast send failed";
+        return false;
+      }
+    } else {
+      if (!exchange(-1, nullptr, 0, prev_fd_, e->data, e->nbytes)) {
+        *err = "broadcast recv failed";
+        return false;
+      }
+      if (next != root &&
+          !exchange(next_fd_, e->data, e->nbytes, -1, nullptr, 0)) {
+        *err = "broadcast forward failed";
+        return false;
+      }
+    }
+    return true;
+  }
+
+  void fail_all_pending(const std::string& why) {
+    std::map<std::string, std::deque<Entry>> taken;
+    {
+      std::lock_guard<std::mutex> lock(table_mu_);
+      // mark the plane dead FIRST: later enqueues must error immediately
+      // instead of parking entries no comm thread will ever order
+      running_ = false;
+      taken.swap(table_);
+    }
+    table_cv_.notify_all();
+    for (auto& kv : taken)
+      for (auto& e : kv.second)
+        if (e.complete) e.complete(false, why);
+  }
+
+  int rank_ = 0;
+  int size_ = 1;
+  std::atomic<bool> started_{false};  // init succeeded (thread/fd lifetime)
+  std::atomic<bool> running_{false};  // plane healthy (cleared on error)
+  std::thread comm_thread_;
+  int wake_pipe_[2] = {-1, -1};       // rank 0: enqueue -> comm poll wakeup
+
+  int ctrl0_fd_ = -1;                 // worker -> rank 0
+  std::vector<int> ctrl_fds_;        // rank 0 -> workers (index = rank)
+  std::mutex ctrl_send_mu_;
+  int next_fd_ = -1, prev_fd_ = -1;  // the ring
+
+  std::mutex api_mu_;
+  std::mutex enqueue_order_mu_;  // serializes {table insert, READY send}
+  std::mutex table_mu_;
+  std::condition_variable table_cv_;
+  std::map<std::string, std::deque<Entry>> table_;
+
+  std::mutex local_ready_mu_;
+  std::deque<LocalReady> local_ready_;
+
+  // rank 0 only (touched solely by the comm thread)
+  std::map<std::string, std::deque<PendingGen>> negotiating_;
+  std::deque<OrderItem> orders_;
+};
+}  // namespace hvdplane
+
+#endif  // HVD_PLANE_H_

@@ -1,0 +1,147 @@
+// C API over the framework-agnostic collective plane (plane.h): the
+// binding surface for ctypes frontends — horovod_tpu.torch routes its
+// hook-driven gradients through this instead of the per-tensor
+// numpy bridge into the Python eager core (the role of the reference's
+// native torch binding, torch/mpi_ops_v2.cc:52-110).
+//
+// Async enqueue + wait: ComputeAsync-equivalent semantics. An enqueue
+// returns an integer handle; the comm thread fulfills it when the ring
+// collective completes; hvd_plane_wait blocks (GIL released by ctypes)
+// with a timeout. Built into libhvd_plane.so (no TensorFlow linkage).
+
+#include "plane.h"
+
+#include <memory>
+
+namespace {
+
+struct Pending {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  bool ok = false;
+  std::string err;
+};
+
+std::mutex g_table_mu;
+std::map<long long, std::shared_ptr<Pending>> g_table;
+long long g_next = 0;
+
+long long submit(hvdplane::Entry e, const char* name) {
+  auto p = std::make_shared<Pending>();
+  long long h;
+  {
+    std::lock_guard<std::mutex> lock(g_table_mu);
+    h = g_next++;
+    g_table[h] = p;
+  }
+  e.complete = [p](bool ok, const std::string& err) {
+    std::lock_guard<std::mutex> lock(p->mu);
+    p->done = true;
+    p->ok = ok;
+    p->err = err;
+    p->cv.notify_all();
+  };
+  hvdplane::Plane::instance().enqueue(name, std::move(e));
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+HVDPLANE_EXPORT int hvd_plane_init(int rank, int size, const char* coord_host,
+                   int coord_port, double timeout_s) {
+  return hvdplane::Plane::instance().init(
+             rank, size, coord_host,
+             static_cast<uint16_t>(coord_port), timeout_s)
+             ? 0
+             : 1;
+}
+
+HVDPLANE_EXPORT void hvd_plane_shutdown() { hvdplane::Plane::instance().shutdown(); }
+
+HVDPLANE_EXPORT int hvd_plane_initialized() {
+  return hvdplane::Plane::instance().initialized() ? 1 : 0;
+}
+
+HVDPLANE_EXPORT int hvd_plane_size() { return hvdplane::Plane::instance().size(); }
+HVDPLANE_EXPORT int hvd_plane_rank() { return hvdplane::Plane::instance().rank(); }
+
+// dtype codes are hvdplane::DType (F32=0, F64, I32, I64, F16, BF16).
+// dims feed the cross-rank shape digest; data is reduced IN PLACE.
+HVDPLANE_EXPORT long long hvd_plane_allreduce_async(const char* name, void* data,
+                                    long long nbytes, int dtype,
+                                    int average, const int64_t* dims,
+                                    int ndims) {
+  if (!hvd_plane_initialized()) return -1;
+  hvdplane::Entry e;
+  e.op = hvdplane::ALLREDUCE;
+  e.dtype = static_cast<uint32_t>(dtype);
+  e.average = average != 0;
+  e.shape_hash = hvdplane::shape_digest_dims(ndims, dims);
+  e.data = static_cast<char*>(data);
+  e.nbytes = static_cast<size_t>(nbytes);
+  return submit(std::move(e), name);
+}
+
+HVDPLANE_EXPORT long long hvd_plane_broadcast_async(const char* name, void* data,
+                                    long long nbytes, int dtype, int root,
+                                    const int64_t* dims, int ndims) {
+  if (!hvd_plane_initialized()) return -1;
+  hvdplane::Entry e;
+  e.op = hvdplane::BROADCAST;
+  e.dtype = static_cast<uint32_t>(dtype);
+  e.root = root;
+  e.shape_hash = hvdplane::shape_digest_dims(ndims, dims);
+  e.data = static_cast<char*>(data);
+  e.nbytes = static_cast<size_t>(nbytes);
+  return submit(std::move(e), name);
+}
+
+// 1 iff the collective behind the handle has completed (success or
+// failure); 0 while in flight or for unknown handles. Does NOT release
+// the handle — hvd_plane_wait still joins and releases it.
+HVDPLANE_EXPORT int hvd_plane_poll(long long handle) {
+  std::shared_ptr<Pending> p;
+  {
+    std::lock_guard<std::mutex> lock(g_table_mu);
+    auto it = g_table.find(handle);
+    if (it == g_table.end()) return 0;
+    p = it->second;
+  }
+  std::lock_guard<std::mutex> lock(p->mu);
+  return p->done ? 1 : 0;
+}
+
+// 0 = ok, 1 = collective failed (err copied out), 2 = timeout,
+// 3 = unknown handle. A finished handle is released.
+HVDPLANE_EXPORT int hvd_plane_wait(long long handle, double timeout_s, char* errbuf,
+                   int errlen) {
+  std::shared_ptr<Pending> p;
+  {
+    std::lock_guard<std::mutex> lock(g_table_mu);
+    auto it = g_table.find(handle);
+    if (it == g_table.end()) return 3;
+    p = it->second;
+  }
+  std::unique_lock<std::mutex> lock(p->mu);
+  if (!p->cv.wait_for(lock,
+                      std::chrono::milliseconds(
+                          static_cast<int64_t>(timeout_s * 1000)),
+                      [&] { return p->done; }))
+    return 2;
+  bool ok = p->ok;
+  if (!ok && errbuf && errlen > 0) {
+    std::snprintf(errbuf, static_cast<size_t>(errlen), "%s",
+                  p->err.c_str());
+  }
+  lock.unlock();
+  {
+    std::lock_guard<std::mutex> tlock(g_table_mu);
+    g_table.erase(handle);
+  }
+  return ok ? 0 : 1;
+}
+
+}  // extern "C"

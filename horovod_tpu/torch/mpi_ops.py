@@ -70,16 +70,54 @@ def _to_torch(value, dtype, like=None):
     return out
 
 
+# native-plane handles live beside the core's integer handles; the map
+# value ("native", plane_handle, staging, target, restore_dtype) lets
+# synchronize() dispatch (see torch/native.py — the factored TCP-ring
+# plane, the reference's C-binding seam torch/mpi_ops_v2.cc:52-130)
+_NATIVE_TAG = "hvdnative"
+_native_seq = [0]
+
+
+def _native_route(tensor, average):
+    """True when this collective should ride the native plane: CPU wire
+    dtype, multi-process, plane up (lazily bootstrapped), and not an
+    integer average (the ring sums; int division is undefined there,
+    matching the TF kernel's guard)."""
+    from . import native as _nat
+    if not _nat.supported(tensor):
+        return False
+    if average and not tensor.dtype.is_floating_point:
+        return False
+    return _nat.ensure_plane(process_rank(), process_count())
+
+
 def allreduce_async(tensor, average=True, name=None,
                     compression=Compression.none):
-    """Queue an allreduce of a torch tensor; returns an integer handle
+    """Queue an allreduce of a torch tensor; returns a handle
     (reference torch/mpi_ops.py:69-108)."""
     compressed, ctx = compression.compress(tensor)
+    restore = tensor.dtype if ctx is None else ctx
+    if _native_route(compressed, average):
+        from . import native as _nat
+        # out-of-place: reduce a private copy in place natively
+        staging = compressed.detach().clone().contiguous()
+        h, staging = _nat.allreduce_async_(
+            staging, average=average, name=name or _auto_name("allreduce"))
+        key = f"{_NATIVE_TAG}.{h}"
+        _handle_map[key] = ("native", h, staging, None, restore, tensor)
+        return key
     handle = _core.allreduce_async(_to_numpy(compressed), average=average,
                                    name=name, kind="replicated")
-    _handle_map[handle] = (None, tensor.dtype if ctx is None else ctx,
-                           tensor)
+    _handle_map[handle] = (None, restore, tensor)
     return handle
+
+
+def _auto_name(op):
+    # rank-consistent fallback naming: every process runs the same
+    # program, so the counter advances identically (the negotiated core
+    # path relies on the same property)
+    _native_seq[0] += 1
+    return f"torch.{op}.{_native_seq[0]}"
 
 
 def allreduce_async_(tensor, average=True, name=None,
@@ -87,10 +125,18 @@ def allreduce_async_(tensor, average=True, name=None,
     """In-place async allreduce: on synchronize, the result is copied back
     into ``tensor`` (reference torch/mpi_ops.py:133-178)."""
     compressed, ctx = compression.compress(tensor)
+    restore = tensor.dtype if ctx is None else ctx
+    if _native_route(compressed, average):
+        from . import native as _nat
+        h, staging = _nat.allreduce_async_(
+            compressed, average=average,
+            name=name or _auto_name("allreduce"))
+        key = f"{_NATIVE_TAG}.{h}"
+        _handle_map[key] = ("native", h, staging, tensor, restore, tensor)
+        return key
     handle = _core.allreduce_async(_to_numpy(compressed), average=average,
                                    name=name, kind="replicated")
-    _handle_map[handle] = (tensor, tensor.dtype if ctx is None else ctx,
-                           tensor)
+    _handle_map[handle] = (tensor, restore, tensor)
     return handle
 
 
@@ -120,6 +166,16 @@ def allgather(tensor, name=None):
 
 
 def broadcast_async(tensor, root_rank=0, name=None):
+    if _native_route(tensor, average=False):
+        from . import native as _nat
+        staging = tensor.detach().clone().contiguous()
+        h, staging = _nat.broadcast_async_(
+            staging, root_rank=root_rank,
+            name=name or _auto_name("broadcast"))
+        key = f"{_NATIVE_TAG}.{h}"
+        _handle_map[key] = ("native", h, staging, None, tensor.dtype,
+                            tensor)
+        return key
     handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
                                    name=name, kind="replicated")
     _handle_map[handle] = (None, tensor.dtype, tensor)
@@ -127,6 +183,15 @@ def broadcast_async(tensor, root_rank=0, name=None):
 
 
 def broadcast_async_(tensor, root_rank=0, name=None):
+    if _native_route(tensor, average=False):
+        from . import native as _nat
+        h, staging = _nat.broadcast_async_(
+            tensor, root_rank=root_rank,
+            name=name or _auto_name("broadcast"))
+        key = f"{_NATIVE_TAG}.{h}"
+        _handle_map[key] = ("native", h, staging, tensor, tensor.dtype,
+                            tensor)
+        return key
     handle = _core.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
                                    name=name, kind="replicated")
     _handle_map[handle] = (tensor, tensor.dtype, tensor)
@@ -146,6 +211,10 @@ def broadcast_(tensor, root_rank=0, name=None):
 def poll(handle):
     """True iff the collective behind ``handle`` has completed (reference
     torch/mpi_ops.py:406-419)."""
+    entry = _handle_map.get(handle)
+    if entry is not None and entry[0] == "native":
+        from . import native as _nat
+        return _nat.poll(entry[1])
     return _core.poll(handle)
 
 
@@ -158,7 +227,25 @@ def synchronize(handle):
             f"handle {handle} was not created by this frontend or has "
             "already been synchronized (reference HandleManager guard, "
             "torch/handle_manager.h:30-41)")
-    target, dtype, like = _handle_map[handle]
+    entry = _handle_map[handle]
+    if entry[0] == "native":
+        from . import native as _nat
+        _, h, staging, target, restore, like = entry
+        # pop regardless of outcome: a failed wait erased the C-side
+        # handle too, so a retry could only get a misleading
+        # unknown-handle error — unlike the core path, there is nothing
+        # transient to retry against
+        try:
+            _nat.wait(h, staging,
+                      target if target is not None else staging)
+        finally:
+            _handle_map.pop(handle, None)
+        out = staging if target is None else target
+        # out-of-place with a cast compressor: restore the caller dtype
+        # (in-place handles reduced the caller's own buffer, where
+        # out.dtype == restore by construction)
+        return out.to(restore) if out.dtype != restore else out
+    target, dtype, like = entry
     # join first, pop after: a transient core error (StalledError) must
     # leave the mapping intact so a retry doesn't hit a bare KeyError
     result = _core.synchronize(handle)

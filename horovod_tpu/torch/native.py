@@ -1,0 +1,205 @@
+"""Native collective plane for the torch frontend (libhvd_plane.so).
+
+The reference binds torch to its C++ core (torch/mpi_ops_v2.cc:52-130);
+here the equivalent seam is the framework-agnostic plane factored out of
+the TF custom ops (_native/src/plane.h: rank-0-negotiated TCP control
+plane + TCP ring data plane) exposed through a C API
+(_native/src/plane_c.cc) and driven over ctypes. Gradients move ring
+rank-to-rank in C with the GIL released — no per-tensor numpy bridge
+into the Python eager core, no pickled control messages.
+
+Degrades cleanly: no toolchain / ``HVD_TORCH_NATIVE=0`` / no rendezvous
+address → callers keep the numpy-bridge route in torch/mpi_ops.py.
+
+dtype wire formats are the plane's own (F16/BF16 ride 16-bit and sum in
+fp32 per element — plane.h reduce_add), so bf16 torch tensors move HALF
+the bytes the numpy bridge moves (it widens to fp32 because numpy has
+no bfloat16).
+"""
+
+import atexit
+import ctypes
+import os
+
+import torch
+
+from .. import _native
+from ..common import hvd_logging as log
+
+_state = {"cdll": None, "plane_up": False, "failed": False}
+
+# hvdplane::DType codes (plane.h)
+_DTYPE = {
+    torch.float32: 0,
+    torch.float64: 1,
+    torch.int32: 2,
+    torch.int64: 3,
+    torch.float16: 4,
+    torch.bfloat16: 5,
+}
+
+# Port offset above the HVD_COORDINATOR_ADDR rendezvous port for the
+# torch plane's rank-0 listener. Distinct from the TF plane's +1900 and
+# the Python negotiation plane's +1000, so frontends can coexist.
+TORCH_PLANE_PORT_OFFSET = 2100
+
+
+def _load():
+    if _state["cdll"] is not None:
+        return _state["cdll"]
+    if _state["failed"]:
+        return None
+    if os.environ.get("HVD_TORCH_NATIVE", "").lower() in ("0", "false"):
+        _state["failed"] = True
+        return None
+    try:
+        path = _native.build_plane()
+        cdll = ctypes.CDLL(path)
+        c = ctypes
+        cdll.hvd_plane_init.restype = c.c_int
+        cdll.hvd_plane_init.argtypes = [c.c_int, c.c_int, c.c_char_p,
+                                        c.c_int, c.c_double]
+        cdll.hvd_plane_initialized.restype = c.c_int
+        cdll.hvd_plane_size.restype = c.c_int
+        cdll.hvd_plane_rank.restype = c.c_int
+        cdll.hvd_plane_allreduce_async.restype = c.c_longlong
+        cdll.hvd_plane_allreduce_async.argtypes = [
+            c.c_char_p, c.c_void_p, c.c_longlong, c.c_int, c.c_int,
+            c.POINTER(c.c_int64), c.c_int]
+        cdll.hvd_plane_broadcast_async.restype = c.c_longlong
+        cdll.hvd_plane_broadcast_async.argtypes = [
+            c.c_char_p, c.c_void_p, c.c_longlong, c.c_int, c.c_int,
+            c.POINTER(c.c_int64), c.c_int]
+        cdll.hvd_plane_wait.restype = c.c_int
+        cdll.hvd_plane_wait.argtypes = [c.c_longlong, c.c_double,
+                                        c.c_char_p, c.c_int]
+        cdll.hvd_plane_poll.restype = c.c_int
+        cdll.hvd_plane_poll.argtypes = [c.c_longlong]
+        _state["cdll"] = cdll
+    except Exception as exc:  # noqa: BLE001 — no g++ / load error
+        log.debug(f"native torch plane unavailable, using the numpy "
+                  f"bridge: {exc}")
+        _state["failed"] = True
+        return None
+    return _state["cdll"]
+
+
+def available():
+    return _load() is not None
+
+
+def _plane_endpoint():
+    addr = os.environ.get("HVD_TORCH_NATIVE_ADDR")
+    if addr:
+        host, _, port = addr.rpartition(":")
+        try:
+            return host, int(port)
+        except ValueError:
+            log.warning(f"malformed HVD_TORCH_NATIVE_ADDR {addr!r} (want "
+                        "host:port); using the numpy bridge")
+            return None
+    coord = os.environ.get("HVD_COORDINATOR_ADDR")
+    if not coord:
+        return None
+    host, _, port = coord.rpartition(":")
+    try:
+        return host, int(port) + TORCH_PLANE_PORT_OFFSET
+    except ValueError:
+        return None
+
+
+def ensure_plane(rank, size):
+    """Bring the plane up (idempotent); True when the native route can be
+    used. Failure is cached — retrying would stall every step."""
+    if size <= 1:
+        return False  # identity collectives: the bridge path is free
+    if _state["failed"] or _load() is None:
+        return False
+    if _state["plane_up"]:
+        return True
+    ep = _plane_endpoint()
+    if ep is None:
+        log.debug("native torch plane: no HVD_COORDINATOR_ADDR / "
+                  "HVD_TORCH_NATIVE_ADDR rendezvous; using the bridge")
+        _state["failed"] = True
+        return False
+    timeout = float(os.environ.get("HVD_TORCH_NATIVE_TIMEOUT", "60"))
+    rc = _state["cdll"].hvd_plane_init(rank, size, ep[0].encode(), ep[1],
+                                       timeout)
+    if rc != 0:
+        log.warning(f"native torch plane init failed (rank {rank}, "
+                    f"{ep[0]}:{ep[1]}); using the numpy bridge")
+        _state["failed"] = True
+        return False
+    _state["plane_up"] = True
+    atexit.register(shutdown_plane)
+    return True
+
+
+def shutdown_plane():
+    if _state["plane_up"] and _state["cdll"] is not None:
+        _state["cdll"].hvd_plane_shutdown()
+        _state["plane_up"] = False
+
+
+def supported(tensor):
+    """Native-route eligibility: a CPU-resident torch tensor of a wire
+    dtype (anything else falls back to the bridge, which also owns the
+    not-a-tensor error surface)."""
+    return (isinstance(tensor, torch.Tensor)
+            and tensor.device.type == "cpu" and tensor.dtype in _DTYPE)
+
+
+def _dims(tensor):
+    arr = (ctypes.c_int64 * tensor.dim())(*tensor.shape)
+    return arr, tensor.dim()
+
+
+def allreduce_async_(tensor, average=True, name=""):
+    """In-place ring allreduce on the tensor's own storage; returns a
+    plane handle (wait with :func:`wait`). The tensor must stay alive
+    and unmodified until the wait returns."""
+    t = tensor if tensor.is_contiguous() else tensor.contiguous()
+    dims, ndims = _dims(t)
+    h = _state["cdll"].hvd_plane_allreduce_async(
+        name.encode(), ctypes.c_void_p(t.data_ptr()),
+        t.numel() * t.element_size(), _DTYPE[t.dtype],
+        1 if average else 0, dims, ndims)
+    return h, t
+
+
+def broadcast_async_(tensor, root_rank=0, name=""):
+    t = tensor if tensor.is_contiguous() else tensor.contiguous()
+    dims, ndims = _dims(t)
+    h = _state["cdll"].hvd_plane_broadcast_async(
+        name.encode(), ctypes.c_void_p(t.data_ptr()),
+        t.numel() * t.element_size(), _DTYPE[t.dtype], root_rank,
+        dims, ndims)
+    return h, t
+
+
+def poll(handle):
+    """True iff the plane finished the collective (success or failure);
+    does not release the handle."""
+    return bool(_state["cdll"].hvd_plane_poll(handle))
+
+
+def wait(handle, staging, target, timeout_s=None):
+    """Block until the plane finishes ``handle``; copies ``staging`` back
+    into ``target`` when contiguity forced a staging buffer."""
+    if handle < 0:
+        raise RuntimeError("native torch plane rejected the collective "
+                           "(plane not initialized)")
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("HVD_TORCH_NATIVE_TIMEOUT", "60"))
+    err = ctypes.create_string_buffer(512)
+    rc = _state["cdll"].hvd_plane_wait(handle, timeout_s, err, len(err))
+    if rc == 2:
+        raise RuntimeError(
+            f"native torch collective timed out after {timeout_s}s")
+    if rc != 0:
+        raise RuntimeError("native torch collective failed: "
+                           f"{err.value.decode(errors='replace')}")
+    if staging is not target:
+        target.copy_(staging)
+    return target

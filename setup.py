@@ -30,6 +30,12 @@ class BuildNative(Command):
         path = _native.build(force=True)
         print(f"built {path}")
         try:
+            path = _native.build_plane(force=True)
+            print(f"built {path}")
+        except Exception as exc:  # toolchain hiccup: torch uses the bridge
+            print(f"WARNING: libhvd_plane.so build FAILED (the torch "
+                  f"frontend will use the numpy bridge): {exc}")
+        try:
             path = _native.build_tf(force=True)
             print(f"built {path}")
         except ImportError as exc:  # no TF in this env: optional extension
