@@ -10,7 +10,18 @@ echo "--- build native core"
 python setup.py build_native
 
 echo "--- unit + integration tests (8-device virtual mesh)"
-python -m pytest tests/ -q
+# Sharded across CPU cores when pytest-xdist is present: the suite is
+# wall-clock-bound by subprocess spawns + compiles, and the files are
+# independent (loadfile keeps each file's fixtures in one worker; every
+# multi-process rendezvous uses per-run free ports, so shards can't
+# collide). HVD_TEST_WORKERS overrides; on a 1-core host auto==1 and
+# behavior is identical to a serial run.
+if python -c "import xdist" 2>/dev/null; then
+    python -m pytest tests/ -q -n "${HVD_TEST_WORKERS:-auto}" \
+        --dist loadfile
+else
+    python -m pytest tests/ -q
+fi
 
 echo "--- driver contract: env-free multi-chip dryrun"
 # Must pass with NO env vars pre-set (the driver runs it exactly this way
