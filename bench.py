@@ -65,12 +65,13 @@ def _bench_autotune(hvd, n_tensors=8, mb=16):
     regime that stopped existing at freeze, and lost 37% on-chip).
 
     The burst is 8 x 16MB: large tensors are where the threshold knob
-    has a real optimum below the default. At 64MB the planner packs 4
-    tensors per fusion buffer, paying concat + split HBM traffic (~3x
-    the payload) to save dispatches; unfused singles skip the copies.
-    The tuner has a genuine ~tens-of-percent win to find by dropping
-    the threshold under the tensor size. Re-inits the library
-    (autotune config is read at init)."""
+    trades fusion's concat+split HBM traffic (~3x the payload) against
+    its dispatch savings. Measured verdict (r4, docs/tensor-fusion.md):
+    on this tunneled runtime the two effects nearly cancel and the
+    defaults sit in a shallow optimum — expect SMALL positive gains
+    (+0.3-4.5%), not tens of percent; anything larger in either
+    direction is session drift, which is why the validation below is
+    PAIRED. Re-inits the library (autotune config is read at init)."""
     import time
 
     import jax
@@ -91,11 +92,16 @@ def _bench_autotune(hvd, n_tensors=8, mb=16):
         coord = state.global_state().coordinator
         rates = []
         for it in range(bursts):
+            # t0 BEFORE the burst is released: the background cycle
+            # thread may flush (and the device finish) the moment
+            # hold_cycle exits, so a timer started after it races the
+            # work it means to measure (r4: measured impossible TB/s
+            # rates from exactly that race)
+            t0 = time.perf_counter()
             with coord.hold_cycle():  # land the burst in one cycle
                 handles = [hvd.allreduce_async(t, average=False,
                                                name=f"at.{tag}.{it}.{i}")
                            for i, t in enumerate(tensors)]
-            t0 = time.perf_counter()
             coord.flush()
             outs = [hvd.synchronize(h) for h in handles]
             jax.block_until_ready(outs)  # barrier without a d2h copy
@@ -118,28 +124,30 @@ def _bench_autotune(hvd, n_tensors=8, mb=16):
             burst_rate(f"warm{int(thr)}", 2, 0)
         cfg.fusion_threshold = saved_thr
 
-    measure = 7
     # both legs must run against a KNOWN autotune state regardless of
     # the caller's env: force it off for the default leg, on for the
-    # tuned leg, and restore the caller's setting afterwards
+    # tuned leg, and restore the caller's setting afterwards. The
+    # whole body sits inside the try: this leg now runs FIRST in
+    # main(), so a failure here (e.g. OOM in a prewarm burst) must
+    # still restore the env and a live hvd for the headline benches.
     prior = os.environ.pop("HOROVOD_AUTOTUNE", None)
-    if prior is not None:
-        hvd.shutdown()
-        hvd.init()
-    # distinct bucket patterns for 8 equal tensors: cap/tensor = 0..8
-    per = mb << 20
-    prewarm([0, per, 2 * per, 3 * per, 4 * per, 6 * per, 64 << 20])
-    default_rate = burst_rate("off", 9, measure)
-
-    hvd.shutdown()
-    os.environ["HOROVOD_AUTOTUNE"] = "1"
-    # Bench-scale exploration budget: a scored GP point normally costs
-    # CYCLES_PER_SAMPLE * SAMPLES_PER_STEP (= 50) cycles — shrink the
-    # windows so several points fit in the bench. Passive scoring needs
-    # one extra burst per window to seed the inter-flush timestamp.
     saved = (autotune_mod.CYCLES_PER_SAMPLE,
              autotune_mod.SAMPLES_PER_STEP)
     try:
+        if prior is not None:
+            hvd.shutdown()
+            hvd.init()
+        # distinct bucket patterns for 8 equal tensors: cap/tensor 0..8
+        per = mb << 20
+        prewarm([0, per, 2 * per, 3 * per, 4 * per, 6 * per, 64 << 20])
+
+        hvd.shutdown()
+        os.environ["HOROVOD_AUTOTUNE"] = "1"
+        # Bench-scale exploration budget: a scored GP point normally
+        # costs CYCLES_PER_SAMPLE * SAMPLES_PER_STEP (= 50) cycles —
+        # shrink the windows so several points fit in the bench.
+        # Passive scoring needs one extra burst per window to seed the
+        # inter-flush timestamp.
         try:
             autotune_mod.CYCLES_PER_SAMPLE = 3
             autotune_mod.SAMPLES_PER_STEP = 3
@@ -154,18 +162,36 @@ def _bench_autotune(hvd, n_tensors=8, mb=16):
         # converge: adopt the best point and stop tuning
         # (coordinator.freeze_autotune)
         best = coord.freeze_autotune()
-        tuned_rate = burst_rate("on", 9, measure)
-        # validate like the reference's ParameterManager (tuned values
-        # are only kept when they beat the baseline): the bench-scale
-        # 3x3 scoring windows are noisy enough that the GP occasionally
-        # crowns a bad point — measure it, and fall back to the
-        # defaults if it lost
+        # Validate like the reference's ParameterManager (tuned values
+        # are only kept when they beat the baseline) — but PAIRED: the
+        # tunneled runtime's absolute eager throughput drifts by 2x
+        # minute-to-minute, so default and tuned legs measured minutes
+        # apart compare drift, not knobs (r4: the same adopted point
+        # measured +19% and -41% in back-to-back full runs). Alternating
+        # the knob settings burst-round by burst-round makes the drift
+        # common-mode.
+        cfg = state.global_state().config
+        tuned_knobs = (cfg.fusion_threshold, cfg.cycle_time_ms)
+        default_knobs = (64 << 20, 5.0)
+        d_rates, t_rates = [], []
+        for rd in range(6):
+            # counterbalanced order (d,t / t,d by round): a strict d,t
+            # sequence would hand every tuned sample the later slot of
+            # its pair, so monotonic within-session drift would bias
+            # the keep/revert decision instead of cancelling
+            order = ((default_knobs, d_rates), (tuned_knobs, t_rates))
+            if rd % 2:
+                order = order[::-1]
+            for knobs, sink in order:
+                cfg.fusion_threshold, cfg.cycle_time_ms = knobs
+                sink.append(burst_rate(f"v{rd}.{int(knobs[0])}", 3, 2))
+        default_rate = float(np.median(d_rates))
+        tuned_rate = float(np.median(t_rates))
         kept = tuned_rate >= default_rate
         if not kept:
             # revert the LIVE knobs: freeze_autotune wrote the adopted
             # point into the coordinator's config, which is what the
             # fusion planner actually reads
-            cfg = state.global_state().config
             cfg.fusion_threshold = 64 << 20
             cfg.cycle_time_ms = 5.0
     finally:
@@ -201,6 +227,17 @@ def main():
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+
+    # Autotune leg FIRST: its knob comparison needs a quiet device.
+    # After the ResNet/transformer benches, residual HBM state and the
+    # tunneled runtime's session both degrade absolute eager throughput
+    # ~50x (measured r4: 52 GB/s fresh vs ~1 GB/s after the benches),
+    # flattening the tuned-vs-default contrast into noise.
+    try:
+        autotune = _bench_autotune(hvd)
+    except Exception as e:  # noqa: BLE001 — headline metrics still print
+        print(f"autotune bench failed: {e}", file=sys.stderr)
+        autotune = {"error": str(e)[:200]}
     image_size = 224 if on_tpu else 64
     # Largest per-chip batch that compiles+runs wins MXU utilization; fall
     # back on OOM (RESOURCE_EXHAUSTED) so the bench always completes.
@@ -248,12 +285,6 @@ def main():
     except Exception as e:  # noqa: BLE001 — ResNet line must still print
         print(f"transformer bench failed: {e}", file=sys.stderr)
         tlm = {"error": str(e)[:200]}
-
-    try:
-        autotune = _bench_autotune(hvd)
-    except Exception as e:  # noqa: BLE001 — headline metrics still print
-        print(f"autotune bench failed: {e}", file=sys.stderr)
-        autotune = {"error": str(e)[:200]}
 
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",

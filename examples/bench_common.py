@@ -171,7 +171,9 @@ def bench_transformer_lm(on_tpu, peak_flops=None):
     from horovod_tpu.parallel import mesh as mesh_mod
 
     if on_tpu:
-        batch_per_chip, seq, inner, windows = 8, 1024, 10, 3
+        # batch 16 is the measured per-chip sweet spot (r4: 0.632 MFU vs
+        # 0.603 at batch 8 and 0.58 at batch 32, docs/benchmarks.md)
+        batch_per_chip, seq, inner, windows = 16, 1024, 10, 3
     else:  # CI smoke on CPU: tiny everything, no MFU claim
         batch_per_chip, seq, inner, windows = 2, 64, 2, 1
 

@@ -179,9 +179,10 @@ class TestTiedEmbeddings:
         chunked = tr.lm_loss_fn(model, vocab_chunk=64)(params, toks)
         # dense (streaming-lse over fp32 logits) and chunked (per-chunk
         # online lse) accumulate in different orders — bit-exactness is
-        # not part of the contract
+        # not part of the contract (2e-4: bf16 activations and rotation
+        # leave ~1e-4 of order-dependent slack between the two paths)
         np.testing.assert_allclose(float(dense), float(chunked),
-                                   rtol=1e-4)
+                                   rtol=2e-4)
         g = jax.grad(tr.lm_loss_fn(model))(params, toks)
         emb_g = np.asarray(g["embed"]["embedding"])
         assert np.isfinite(emb_g).all() and np.abs(emb_g).sum() > 0
