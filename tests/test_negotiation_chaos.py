@@ -77,6 +77,14 @@ class TestNegotiationChaos:
             common = float(np.asarray(hvd.allreduce(
                 np.ones((2,), np.float32), average=False,
                 name="pre.common"))[0])
+            # tighten the stall deadlines only AFTER the warm-up: 8
+            # sequentially-spawned processes can be many seconds apart
+            # at startup on a loaded host, and a deadline covering the
+            # pre-silence phase makes the warm-up itself stall (the
+            # coordinator service reads this config object live)
+            cfg = state.global_state().config
+            cfg.stall_warning_time_seconds = 0.5
+            cfg.stall_shutdown_time_seconds = 2.0
             if r == 3:
                 coord = state.global_state().coordinator
                 coord._paused = True     # mid-cycle silence, no goodbye
@@ -93,10 +101,7 @@ class TestNegotiationChaos:
             hvd.shutdown()
             return result, common
 
-        env = dict(_ENV)
-        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "0.5"
-        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "2.0"
-        results = run(fn, num_proc=8, env=env, start_timeout_s=900.0)
+        results = run(fn, num_proc=8, env=_ENV, start_timeout_s=900.0)
         for r, (result, common) in enumerate(results):
             assert common == 8.0, results
             if r == 3:
@@ -104,6 +109,58 @@ class TestNegotiationChaos:
             else:
                 assert result in ("stalled", "shutdown"), \
                     f"rank {r}: {result}"
+
+    def test_coordinator_dies_abruptly(self):
+        """The coordinator SERVICE vanishes mid-run (no shutdown
+        protocol — the rank-0 crash case). Peers' cycles hit a dead
+        socket; after the poison grace window their pending work must
+        fail with ShutdownError naming the unreachable control plane,
+        never hang."""
+        def fn():
+            import os
+            import time
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.common import state
+            from horovod_tpu.ops import eager
+
+            eager.EagerCoordinator.POISON_GRACE_S = 1.0
+            hvd.init()
+            r = int(os.environ["HVD_PROCESS_ID"])
+            common = float(np.asarray(hvd.allreduce(
+                np.ones((2,), np.float32), average=False,
+                name="pre.crash"))[0])
+            coord = state.global_state().coordinator
+            if r == 0:
+                # kill the service with no goodbye: peers see connection
+                # failures, exactly as if rank 0's host died
+                coord._negotiator.service.shutdown()
+                time.sleep(8.0)
+                return "crashed", common
+            result = "hung"
+            try:
+                hvd.allreduce(np.ones((2,), np.float32),
+                              name="post.crash")
+                result = "completed"
+            except hvd.ShutdownError as e:
+                result = ("unreachable" if "unreachable" in str(e)
+                          else "shutdown")
+            except hvd.StalledError:
+                result = "stalled"
+            return result, common
+
+        results = run(fn, num_proc=4, env=_ENV, start_timeout_s=900.0)
+        for r, (result, common) in enumerate(results):
+            assert common == 4.0, results
+            if r == 0:
+                assert result == "crashed"
+            else:
+                assert result in ("unreachable", "shutdown"), \
+                    f"rank {r}: {result}"
+        # the poison path this test exists for must actually fire: at
+        # least one peer's error names the unreachable control plane
+        assert any(res == "unreachable" for res, _ in results[1:]), \
+            results
 
     def test_response_log_overflow_fails_cleanly(self):
         """Every rank bursts more collectives than the coordinator's
