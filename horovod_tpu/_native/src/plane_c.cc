@@ -11,6 +11,7 @@
 
 #include "plane.h"
 
+#include <cstdlib>
 #include <memory>
 
 namespace {
@@ -21,13 +22,21 @@ struct Pending {
   bool done = false;
   bool ok = false;
   std::string err;
+  // allgather: the plane writes into this malloc'd buffer (sized once
+  // every rank's dim0 is negotiated); ownership passes to the caller
+  // through hvd_plane_wait_gather
+  char* gather_out = nullptr;
+  uint64_t gather_rows = 0;
+  ~Pending() { std::free(gather_out); }  // abandoned/failed handles
 };
 
 std::mutex g_table_mu;
 std::map<long long, std::shared_ptr<Pending>> g_table;
 long long g_next = 0;
 
-long long submit(hvdplane::Entry e, const char* name) {
+// allocate a handle + Pending and wire the completion callback; the
+// caller may touch the Pending (e.g. gather_alloc) before enqueueing
+std::pair<long long, std::shared_ptr<Pending>> make_pending() {
   auto p = std::make_shared<Pending>();
   long long h;
   {
@@ -35,13 +44,22 @@ long long submit(hvdplane::Entry e, const char* name) {
     h = g_next++;
     g_table[h] = p;
   }
-  e.complete = [p](bool ok, const std::string& err) {
+  return {h, p};
+}
+
+void wire_complete(hvdplane::Entry* e, std::shared_ptr<Pending> p) {
+  e->complete = [p](bool ok, const std::string& err) {
     std::lock_guard<std::mutex> lock(p->mu);
     p->done = true;
     p->ok = ok;
     p->err = err;
     p->cv.notify_all();
   };
+}
+
+long long submit(hvdplane::Entry e, const char* name) {
+  auto [h, p] = make_pending();
+  wire_complete(&e, p);
   hvdplane::Plane::instance().enqueue(name, std::move(e));
   return h;
 }
@@ -99,6 +117,87 @@ HVDPLANE_EXPORT long long hvd_plane_broadcast_async(const char* name, void* data
   return submit(std::move(e), name);
 }
 
+// Variable-first-dim allgather (allgatherv). dims describe the LOCAL
+// tensor (dims[0] may differ per rank; dims[1:] must agree — enforced
+// by the shape digest over dims[1:]). The output buffer is malloc'd by
+// the comm thread once the negotiated total is known; retrieve it with
+// hvd_plane_wait_gather (which passes ownership) and release it with
+// hvd_plane_free.
+HVDPLANE_EXPORT long long hvd_plane_allgather_async(
+    const char* name, const void* data, long long nbytes, int dtype,
+    const int64_t* dims, int ndims) {
+  if (!hvd_plane_initialized()) return -1;
+  hvdplane::Entry e;
+  e.op = hvdplane::ALLGATHER;
+  e.dtype = static_cast<uint32_t>(dtype);
+  e.shape_hash = hvdplane::shape_digest_dims(ndims > 0 ? ndims - 1 : 0,
+                                             dims + (ndims > 0 ? 1 : 0));
+  e.dim0 = ndims > 0 ? static_cast<uint64_t>(dims[0]) : 1;
+  e.nbytes = static_cast<size_t>(nbytes);  // validation only
+  uint64_t row_elems = 1;
+  for (int d = 1; d < ndims; ++d) row_elems *= static_cast<uint64_t>(dims[d]);
+  e.row_bytes = row_elems * hvdplane::elem_size(
+                                static_cast<uint32_t>(dtype));
+  e.gather_src = static_cast<const char*>(data);
+  e.gather_src_bytes = static_cast<size_t>(nbytes);
+
+  auto [h, p] = make_pending();
+  uint64_t row_bytes = e.row_bytes;
+  e.gather_alloc = [p, row_bytes](uint64_t total_rows) -> char* {
+    char* buf = static_cast<char*>(
+        std::malloc(std::max<uint64_t>(1, total_rows * row_bytes)));
+    std::lock_guard<std::mutex> lock(p->mu);
+    p->gather_out = buf;
+    p->gather_rows = total_rows;
+    return buf;
+  };
+  wire_complete(&e, p);
+  hvdplane::Plane::instance().enqueue(name, std::move(e));
+  return h;
+}
+
+// Join an allgather handle. On rc==0, *out/*total_rows receive the
+// malloc'd result (caller owns it; free with hvd_plane_free). Same rc
+// codes as hvd_plane_wait; on failure any partial buffer is freed.
+HVDPLANE_EXPORT int hvd_plane_wait_gather(long long handle,
+                                          double timeout_s, void** out,
+                                          uint64_t* total_rows,
+                                          char* errbuf, int errlen) {
+  std::shared_ptr<Pending> p;
+  {
+    std::lock_guard<std::mutex> lock(g_table_mu);
+    auto it = g_table.find(handle);
+    if (it == g_table.end()) return 3;
+    p = it->second;
+  }
+  std::unique_lock<std::mutex> lock(p->mu);
+  if (!p->cv.wait_for(lock,
+                      std::chrono::milliseconds(
+                          static_cast<int64_t>(timeout_s * 1000)),
+                      [&] { return p->done; }))
+    return 2;
+  bool ok = p->ok;
+  if (ok) {
+    *out = p->gather_out;
+    *total_rows = p->gather_rows;
+    p->gather_out = nullptr;  // ownership to the caller
+  } else {
+    if (errbuf && errlen > 0)
+      std::snprintf(errbuf, static_cast<size_t>(errlen), "%s",
+                    p->err.c_str());
+    std::free(p->gather_out);
+    p->gather_out = nullptr;
+  }
+  lock.unlock();
+  {
+    std::lock_guard<std::mutex> tlock(g_table_mu);
+    g_table.erase(handle);
+  }
+  return ok ? 0 : 1;
+}
+
+HVDPLANE_EXPORT void hvd_plane_free(void* buf) { std::free(buf); }
+
 // 1 iff the collective behind the handle has completed (success or
 // failure); 0 while in flight or for unknown handles. Does NOT release
 // the handle — hvd_plane_wait still joins and releases it.
@@ -115,7 +214,9 @@ HVDPLANE_EXPORT int hvd_plane_poll(long long handle) {
 }
 
 // 0 = ok, 1 = collective failed (err copied out), 2 = timeout,
-// 3 = unknown handle. A finished handle is released.
+// 3 = unknown handle. A finished handle is released; a TIMED-OUT
+// handle stays registered (the collective may still be in flight and
+// reading caller buffers) — wait again to join it.
 HVDPLANE_EXPORT int hvd_plane_wait(long long handle, double timeout_s, char* errbuf,
                    int errlen) {
   std::shared_ptr<Pending> p;

@@ -154,7 +154,16 @@ def allreduce_(tensor, average=True, name=None,
 
 def allgather_async(tensor, name=None):
     """Concatenate every worker's tensor along dim 0 (reference
-    torch/mpi_ops.py:181-234)."""
+    torch/mpi_ops.py:181-234). First dims may differ per rank
+    (allgatherv); inner dims must agree."""
+    if _native_route(tensor, average=False):
+        from . import native as _nat
+        h, staging = _nat.allgather_async(
+            tensor, name=name or _auto_name("allgather"))
+        key = f"{_NATIVE_TAG}.{h}"
+        _handle_map[key] = ("native_gather", h, staging, None,
+                            tensor.dtype, tensor)
+        return key
     handle = _core.allgather_async(_to_numpy(tensor), name=name,
                                    kind="replicated")
     _handle_map[handle] = (None, tensor.dtype, tensor)
@@ -212,7 +221,7 @@ def poll(handle):
     """True iff the collective behind ``handle`` has completed (reference
     torch/mpi_ops.py:406-419)."""
     entry = _handle_map.get(handle)
-    if entry is not None and entry[0] == "native":
+    if entry is not None and entry[0] in ("native", "native_gather"):
         from . import native as _nat
         return _nat.poll(entry[1])
     return _core.poll(handle)
@@ -228,18 +237,36 @@ def synchronize(handle):
             "already been synchronized (reference HandleManager guard, "
             "torch/handle_manager.h:30-41)")
     entry = _handle_map[handle]
+    if entry[0] == "native_gather":
+        from . import native as _nat
+        _, h, staging, _target, restore, like = entry
+        # on timeout the entry stays: the ring may still be reading the
+        # staging buffer (dropping it would be a use-after-free) and the
+        # C handle remains joinable — retry synchronize(handle)
+        try:
+            out = _nat.wait_gather(h, staging)
+        except _nat.NativeTimeout:
+            raise
+        except Exception:
+            _handle_map.pop(handle, None)
+            raise
+        _handle_map.pop(handle, None)
+        return out
     if entry[0] == "native":
         from . import native as _nat
         _, h, staging, target, restore, like = entry
-        # pop regardless of outcome: a failed wait erased the C-side
-        # handle too, so a retry could only get a misleading
-        # unknown-handle error — unlike the core path, there is nothing
-        # transient to retry against
+        # pop on success/failure; on TIMEOUT the entry stays — the ring
+        # may still be reading/writing the staging buffer and the C
+        # handle remains joinable (retry synchronize(handle))
         try:
             _nat.wait(h, staging,
                       target if target is not None else staging)
-        finally:
+        except _nat.NativeTimeout:
+            raise
+        except Exception:
             _handle_map.pop(handle, None)
+            raise
+        _handle_map.pop(handle, None)
         out = staging if target is None else target
         # out-of-place with a cast compressor: restore the caller dtype
         # (in-place handles reduced the caller's own buffer, where

@@ -28,6 +28,15 @@ from ..common import hvd_logging as log
 
 _state = {"cdll": None, "plane_up": False, "failed": False}
 
+
+class NativeTimeout(RuntimeError):
+    """A wait timed out with the collective possibly still in flight.
+
+    The handle stays registered on BOTH sides (the ring may still be
+    reading the caller's buffers), so the wait can be retried; callers
+    must keep the staging tensors alive until a retry succeeds or the
+    process exits."""
+
 # hvdplane::DType codes (plane.h)
 _DTYPE = {
     torch.float32: 0,
@@ -75,6 +84,15 @@ def _load():
                                         c.c_char_p, c.c_int]
         cdll.hvd_plane_poll.restype = c.c_int
         cdll.hvd_plane_poll.argtypes = [c.c_longlong]
+        cdll.hvd_plane_allgather_async.restype = c.c_longlong
+        cdll.hvd_plane_allgather_async.argtypes = [
+            c.c_char_p, c.c_void_p, c.c_longlong, c.c_int,
+            c.POINTER(c.c_int64), c.c_int]
+        cdll.hvd_plane_wait_gather.restype = c.c_int
+        cdll.hvd_plane_wait_gather.argtypes = [
+            c.c_longlong, c.c_double, c.POINTER(c.c_void_p),
+            c.POINTER(c.c_uint64), c.c_char_p, c.c_int]
+        cdll.hvd_plane_free.argtypes = [c.c_void_p]
         _state["cdll"] = cdll
     except Exception as exc:  # noqa: BLE001 — no g++ / load error
         log.debug(f"native torch plane unavailable, using the numpy "
@@ -184,6 +202,52 @@ def poll(handle):
     return bool(_state["cdll"].hvd_plane_poll(handle))
 
 
+def allgather_async(tensor, name=""):
+    """Variable-first-dim allgather; returns (handle, staging). The
+    result is retrieved with :func:`wait_gather` (the output size is
+    unknown until every rank's first dim is negotiated). The input is
+    SNAPSHOTTED (cloned) at enqueue, matching the bridge path's
+    semantics — later caller mutations cannot race the ring."""
+    t = tensor.detach().clone().contiguous()
+    if t.dim() == 0:
+        t = t.reshape(1)  # rank-1 result contract (TF kernels ditto)
+    dims, ndims = _dims(t)
+    h = _state["cdll"].hvd_plane_allgather_async(
+        name.encode(), ctypes.c_void_p(t.data_ptr()),
+        t.numel() * t.element_size(), _DTYPE[t.dtype], dims, ndims)
+    return h, t
+
+
+def wait_gather(handle, staging, timeout_s=None):
+    """Join an allgather; returns a new tensor [total_rows, *inner]."""
+    if handle < 0:
+        raise RuntimeError("native torch plane rejected the collective "
+                           "(plane not initialized)")
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("HVD_TORCH_NATIVE_TIMEOUT", "60"))
+    err = ctypes.create_string_buffer(512)
+    out = ctypes.c_void_p()
+    rows = ctypes.c_uint64()
+    rc = _state["cdll"].hvd_plane_wait_gather(
+        handle, timeout_s, ctypes.byref(out), ctypes.byref(rows), err,
+        len(err))
+    if rc == 2:
+        raise NativeTimeout(
+            f"native torch collective timed out after {timeout_s}s")
+    if rc != 0:
+        raise RuntimeError("native torch collective failed: "
+                           f"{err.value.decode(errors='replace')}")
+    try:
+        shape = (int(rows.value),) + tuple(staging.shape[1:])
+        result = torch.empty(shape, dtype=staging.dtype)
+        nbytes = result.numel() * result.element_size()
+        if nbytes:
+            ctypes.memmove(result.data_ptr(), out.value, nbytes)
+        return result
+    finally:
+        _state["cdll"].hvd_plane_free(out)
+
+
 def wait(handle, staging, target, timeout_s=None):
     """Block until the plane finishes ``handle``; copies ``staging`` back
     into ``target`` when contiguity forced a staging buffer."""
@@ -195,7 +259,7 @@ def wait(handle, staging, target, timeout_s=None):
     err = ctypes.create_string_buffer(512)
     rc = _state["cdll"].hvd_plane_wait(handle, timeout_s, err, len(err))
     if rc == 2:
-        raise RuntimeError(
+        raise NativeTimeout(
             f"native torch collective timed out after {timeout_s}s")
     if rc != 0:
         raise RuntimeError("native torch collective failed: "

@@ -108,6 +108,45 @@ class TestTorchNativePlane:
         for k in ("f32", "bf16"):
             assert nat[0][k] == bri[0][k] == nat[1][k] == bri[1][k]
 
+    def test_allgatherv_native(self):
+        """Variable-first-dim allgather over the plane: each rank
+        contributes a different number of rows; every rank gets the
+        concatenation in rank order (the reference's allgatherv,
+        mpi_operations.cc:86-173)."""
+        def fn():
+            import os
+            import torch
+            import horovod_tpu.torch as hvd
+            from horovod_tpu.torch import native
+
+            hvd.init()
+            if not native.available():
+                return "unavailable"
+            r = int(os.environ["HVD_PROCESS_ID"])
+            # rank 0: 1 row, rank 1: 2 rows — rows carry the rank
+            t = torch.full((r + 1, 3), float(r), dtype=torch.float32)
+            out = hvd.allgather(t, name="agv")
+            core_free = not any(
+                isinstance(k, int) for k in
+                __import__("horovod_tpu.torch.mpi_ops",
+                           fromlist=["_handle_map"])._handle_map)
+            sc = hvd.allgather(torch.tensor(float(r)), name="agv.scalar")
+            hvd.shutdown()
+            return (out.tolist(), list(out.shape), sc.tolist(),
+                    bool(native._state["plane_up"]), core_free)
+
+        results = run(fn, num_proc=2, env=_ENV)
+        if results[0] == "unavailable":
+            pytest.skip("libhvd_plane.so unavailable in workers")
+        want = [[0.0, 0.0, 0.0], [1.0, 1.0, 1.0], [1.0, 1.0, 1.0]]
+        for out, shape, sc, plane_up, core_free in results:
+            assert out == want
+            assert shape == [3, 3]
+            assert sc == [0.0, 1.0]
+            assert plane_up
+            # the gathers really rode the plane: no eager-core handles
+            assert core_free, "allgather fell back to the numpy bridge"
+
     def test_shape_mismatch_errors(self):
         """Same name, same byte count, different shapes across ranks:
         the shape digest must reject it (plane.h note_ready)."""
