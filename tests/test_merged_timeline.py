@@ -92,6 +92,33 @@ class TestMergedTimeline:
                 1 / 0
         assert not (tmp_path / "m.json").exists()
 
+    def test_merge_combines_all_per_host_trace_files(self, tmp_path):
+        """Multi-host captures write one <host>.trace.json.gz per host;
+        the merge must include every host's events, not an arbitrary
+        first file."""
+        import gzip
+
+        from horovod_tpu.utils import merged_timeline
+
+        tl = tmp_path / "t.json"
+        tl.write_text(
+            '[\n{"name": "clock_sync", "ph": "M", "pid": 0, '
+            '"args": {"epoch_us_at_ts0": 1000000}},\n'
+            '{"name": "ALLREDUCE", "ph": "B", "pid": 1, "ts": 5},\n')
+        session = tmp_path / "plugins" / "profile" / "2026_01_01"
+        session.mkdir(parents=True)
+        for host in ("hosta", "hostb"):
+            with gzip.open(session / f"{host}.trace.json.gz", "wt") as f:
+                json.dump({"traceEvents": [
+                    {"name": f"op-{host}", "ph": "X", "pid": 7,
+                     "ts": 1.0, "dur": 2.0}]}, f)
+        out = tmp_path / "m.json"
+        merged_timeline.merge(str(tl), str(tmp_path), str(out),
+                              profiler_epoch_us=1000100.0)
+        names = {e.get("name") for e in
+                 json.loads(out.read_text())["traceEvents"]}
+        assert {"op-hosta", "op-hostb", "ALLREDUCE"} <= names
+
     def test_merge_rejects_presync_timeline(self, tmp_path):
         from horovod_tpu.utils import merged_timeline
         old = tmp_path / "old.json"

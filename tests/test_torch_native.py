@@ -179,6 +179,36 @@ class TestTorchNativePlane:
             assert got, "shape mismatch did not raise"
             assert after == 2.0
 
+    def test_poll_completes_without_releasing_handle(self):
+        """hvd.poll on a native handle reports completion truthfully and
+        leaves the handle joinable (reference poll/synchronize contract,
+        torch/mpi_ops.py:406-438)."""
+        def fn():
+            import time
+            import torch
+            import horovod_tpu.torch as hvd
+            from horovod_tpu.torch import native
+
+            hvd.init()
+            if not native.available():
+                return "unavailable"
+            h = hvd.allreduce_async_(torch.ones(64), average=False,
+                                     name="poll.t")
+            deadline = time.monotonic() + 30
+            while not hvd.poll(h):
+                if time.monotonic() > deadline:
+                    hvd.shutdown()
+                    return "poll-timeout"
+                time.sleep(0.005)
+            out = hvd.synchronize(h)  # still joinable after poll=True
+            hvd.shutdown()
+            return float(out[0])
+
+        results = run(fn, num_proc=2, env=_ENV)
+        if results[0] == "unavailable":
+            pytest.skip("libhvd_plane.so unavailable in workers")
+        assert results == [2.0, 2.0], results
+
     def test_disabled_env_uses_bridge(self):
         def fn():
             import torch
