@@ -150,7 +150,8 @@ def pipeline_param_specs(pparams, tp=False):
 
 
 def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
-                       dp_axis="dp", pp_axis="pp", tp_axis="tp"):
+                       dp_axis="dp", pp_axis="pp", tp_axis="tp",
+                       sp_axis="sp"):
     """Build a jitted dp × pp (× tp) training step for TransformerLM.
 
     The layer stack is split over ``pp_axis`` (layers_per_stage =
@@ -167,6 +168,15 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
     (pipeline_param_specs(tp=True)), and XLA inserts the tp all-reduces
     inside each stage. Manual code never mentions tp, so the same step
     serves dp×pp and dp×pp×tp meshes.
+
+    Sequence parallelism composes when the mesh carries ``sp_axis`` > 1
+    AND ``cfg.attention_impl`` can attend across sequence shards
+    ('ring'/'ring_flash'/'ulysses'): tokens arrive sp-REPLICATED, each
+    sp member slices its global-position sequence chunk after the shift
+    (so the label shift never straddles a shard boundary), attention
+    runs blockwise over the sp ring inside every pipeline stage, and
+    gradients/loss are sp-means. With attention_impl='full' an sp>1
+    mesh axis is simply left replicated (the pre-round-4 behavior).
 
     Args: ``pparams`` is the stacked layout from ``stack_pipeline_params``
     (used for shape/spec inference — pass the actual params or shapes).
@@ -196,19 +206,39 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
             "last, so tying needs a cross-stage weight exchange; use "
             "make_gspmd_step, or an untied config, for pipeline "
             "parallelism.")
-    block = Block(cfg, sp=None)
+    sp = mesh.shape.get(sp_axis, 1)
+    sp_active = sp > 1 and cfg.attention_impl in ("ring", "ring_flash",
+                                                  "ulysses")
+    # single source for shard_map's manual axes AND ensure_varying's —
+    # desynchronizing them would corrupt gradient scaling
+    manual_axes = ((dp_axis, pp_axis, sp_axis) if sp_active
+                   else (dp_axis, pp_axis))
+    block = Block(cfg, sp=sp_axis if sp_active else None)
     ln_f = nn.RMSNorm(dtype=cfg.dtype)
 
     def per_rank_loss(pparams, tokens):
-        # tokens: [b_loc, S+1] — inputs + shifted targets
+        # tokens: [b_loc, S+1] — inputs + shifted targets. Under sp the
+        # array is sp-replicated; the GLOBAL shift happens here, then
+        # each sp member takes its sequence chunk (a shard-local shift
+        # would pair the wrong tokens at every shard boundary).
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         b_loc, s = inputs.shape
         if b_loc % num_microbatches:
             raise ValueError(
                 f"local batch {b_loc} not divisible by "
                 f"num_microbatches={num_microbatches}")
+        if sp_active:
+            if s % sp:
+                raise ValueError(
+                    f"sequence length {s} not divisible by sp={sp}")
+            s = s // sp
+            start = lax.axis_index(sp_axis) * s
+            inputs = lax.dynamic_slice_in_dim(inputs, start, s, axis=1)
+            targets = lax.dynamic_slice_in_dim(targets, start, s, axis=1)
+            positions = (start + jnp.arange(s))[None, :]
+        else:
+            positions = jnp.arange(s)[None, :]
         x = pparams["embed"]["embedding"][inputs].astype(cfg.dtype)
-        positions = jnp.arange(s)[None, :]
         mb = b_loc // num_microbatches
         x = x.reshape(num_microbatches, mb, s, cfg.d_model)
 
@@ -239,19 +269,24 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
         # replicated embed/head/norm.
         from ..ops.collective_ops import ensure_varying
         vpparams = jax.tree_util.tree_map(
-            lambda p: ensure_varying(p, (dp_axis, pp_axis)), pparams)
+            lambda p: ensure_varying(p, manual_axes), pparams)
         loss, grads = jax.value_and_grad(per_rank_loss)(vpparams, tokens)
-        # dp-average everything; pp-sum the replicated (non-stacked) params
-        # — each is used on exactly one stage, so the sum is the true grad.
+        # ONE fused reduction: dp-average, and under sp also sp-average
+        # (each sp member saw 1/sp of the tokens, so the global token
+        # mean is the mean of the local means); pp-sum below for the
+        # replicated (non-stacked) params — each is used on exactly one
+        # stage, so the sum is the true grad.
+        red_axes = (dp_axis, sp_axis) if sp_active else (dp_axis,)
+        red_ways = dp * (sp if sp_active else 1)
         grads = jax.tree_util.tree_map(
-            lambda g: lax.psum(g, dp_axis) / dp, grads)
+            lambda g: lax.psum(g, red_axes) / red_ways, grads)
         grads = {k: (v if k == "layers" else
                      jax.tree_util.tree_map(
                          lambda g: lax.psum(g, pp_axis), v))
                  for k, v in grads.items()}
         updates, opt_state = tx.update(grads, opt_state, pparams)
         pparams = optax.apply_updates(pparams, updates)
-        return pparams, opt_state, lax.pmean(loss, dp_axis)
+        return pparams, opt_state, lax.pmean(loss, red_axes)
 
     tp = mesh.shape.get(tp_axis, 1)
     # shard_map is manual over (dp, pp) only; its specs must not name
@@ -260,7 +295,7 @@ def make_pipeline_step(cfg, tx, mesh, num_microbatches, pparams,
     opt_specs = trainer_mod.opt_state_specs(tx, pparams, param_specs_tree)
     batch_spec = P(dp_axis, None)
     fn = jax.jit(compat.shard_map(
-        step, mesh=mesh, axis_names=frozenset({dp_axis, pp_axis}),
+        step, mesh=mesh, axis_names=frozenset(manual_axes),
         in_specs=(param_specs_tree, opt_specs, batch_spec),
         out_specs=(param_specs_tree, opt_specs, P())))
 
