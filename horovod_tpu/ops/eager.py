@@ -213,7 +213,16 @@ class EagerCoordinator:
         self._cycle_backoff_until = 0.0
         self._cycle_req_id = 0
         self._negotiation_dead = False
-        self._unannounced = []  # metas not yet delivered to the coordinator
+        # (metas, hit_ids) not yet delivered to the coordinator, or None
+        self._unannounced = None
+        # worker half of the response cache (response_cache.h:43-92):
+        # a name resubmitted with an unchanged signature rides the wire
+        # as one bit (its coordinator-assigned cache id) instead of a
+        # full EntryMeta — the RunBypass steady-state fast path
+        self._neg_cache = {}      # name -> (cache_id, signature)
+        self._neg_cache_ids = {}  # cache_id -> name
+        self._reannounce = set()  # names whose ids came back unknown
+        self._neg_hit_count = 0   # tensors announced as cache bits
         if jax.process_count() > 1:
             from . import negotiation as neg
             addrs = neg.control_addresses()
@@ -524,13 +533,14 @@ class EagerCoordinator:
         if time.monotonic() < self._cycle_backoff_until:
             return  # exponential backoff after control-plane failures
         # Announcements survive transient control-plane failures: a retry
-        # resends the SAME request id + metas, and the coordinator dedupes
-        # on the id — a response lost after the server processed it must
-        # not cause a re-submit (the names were already negotiated away;
-        # re-submitting would plant ghost table rows no rank completes).
-        # While a retry is outstanding, new queue entries wait their turn.
-        if self._unannounced:
-            metas = self._unannounced
+        # resends the SAME request id + metas/hits, and the coordinator
+        # dedupes on the id — a response lost after the server processed
+        # it must not cause a re-submit (the names were already negotiated
+        # away; re-submitting would plant ghost table rows no rank
+        # completes). While a retry is outstanding, new queue entries
+        # wait their turn.
+        if self._unannounced is not None:
+            metas, hit_ids = self._unannounced
         else:
             with self._queue_lock:
                 batch = list(self._queue)
@@ -538,6 +548,7 @@ class EagerCoordinator:
             if self.timeline and batch:
                 self.timeline.mark_cycle_start()
             metas = []
+            hit_ids = []
             for e in batch:
                 if e.kind == "list":  # local-only op: no cross-process leg
                     if self.timeline:
@@ -545,19 +556,33 @@ class EagerCoordinator:
                     self._finish_entries([e], lambda es: self._exec_single(
                         es[0], es[0].op, "list"))
                     continue
-                t = e.tensor
-                dtype = getattr(t, "dtype", None) or np.result_type(t)
-                metas.append(neg.EntryMeta(e.name, e.op, dtype,
-                                           np.shape(t), e.root_rank,
-                                           e.average))
                 self._negotiated_pending[e.name] = e
+                cached = self._neg_cache.get(e.name)
+                if cached is not None:
+                    if cached[1] == e.signature():
+                        hit_ids.append(cached[0])  # steady-state bypass
+                        self._neg_hit_count += 1
+                        continue
+                    # signature changed: full meta (which also makes the
+                    # coordinator invalidate the id for every peer)
+                    del self._neg_cache[e.name]
+                    self._neg_cache_ids.pop(cached[0], None)
+                metas.append(self._meta_of(e, neg))
+            # names whose cache ids came back unknown (evicted or
+            # invalidated at the coordinator): re-announce in full
+            for name in sorted(self._reannounce):
+                e = self._negotiated_pending.get(name)
+                if e is not None and all(m.name != name for m in metas):
+                    metas.append(self._meta_of(e, neg))
+            self._reannounce.clear()
             self._cycle_req_id += 1
         t0 = time.perf_counter()
         try:
             resp = self._negotiator.cycle(metas, self._applied_seq,
-                                          req_id=self._cycle_req_id)
+                                          req_id=self._cycle_req_id,
+                                          hits=neg.encode_hits(hit_ids))
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
-            self._unannounced = metas
+            self._unannounced = (metas, hit_ids)
             now = time.monotonic()
             self._cycle_failures += 1
             if self._cycle_fail_since is None:
@@ -578,7 +603,7 @@ class EagerCoordinator:
                 # dropping state would diverge from the peers anyway.
                 self._fail_pending_negotiated(ShutdownError(
                     f"negotiation control plane unreachable: {exc}"))
-                self._unannounced = []
+                self._unannounced = None
                 self._negotiation_dead = True
                 try:
                     self._cycle_req_id += 1
@@ -588,7 +613,7 @@ class EagerCoordinator:
                 except Exception:  # noqa: BLE001 — plane truly gone
                     pass
             return
-        self._unannounced = []
+        self._unannounced = None
         self._cycle_failures = 0
         self._cycle_fail_since = None
         self._cycle_backoff_until = 0.0
@@ -602,6 +627,13 @@ class EagerCoordinator:
                     self.autotuner.threshold)
                 self._config.cycle_time_ms = float(
                     self.autotuner.cycle_time_ms)
+
+    @staticmethod
+    def _meta_of(e, neg):
+        t = e.tensor
+        dtype = getattr(t, "dtype", None) or np.result_type(t)
+        return neg.EntryMeta(e.name, e.op, dtype, np.shape(t),
+                             e.root_rank, e.average)
 
     def _finish_entries(self, entries, exec_fn):
         """Run exec_fn over entries, then complete them (status, table
@@ -671,6 +703,15 @@ class EagerCoordinator:
             if self.timeline:
                 for e in entries:
                     self.timeline.negotiate_end(e.name)
+            if r.kind == r.EXECUTE and getattr(r, "cache_ids", None):
+                # learn coordinator-assigned cache ids; riding the
+                # seq-ordered log makes every rank's mapping identical
+                for e, cid in zip(entries, r.cache_ids):
+                    old = self._neg_cache.get(e.name)
+                    if old is not None and old[0] != cid:
+                        self._neg_cache_ids.pop(old[0], None)
+                    self._neg_cache[e.name] = (cid, e.signature())
+                    self._neg_cache_ids[cid] = e.name
             if r.kind == r.ERROR:
                 exc = MismatchError(r.error)
                 for e in entries:
@@ -691,6 +732,15 @@ class EagerCoordinator:
                     entries, lambda es: self._exec_single(es[0], r.op,
                                                           "replicated"))
             self._applied_seq = seq
+        for cid in getattr(resp, "unknown_ids", ()):
+            # the coordinator no longer holds this id (evicted, or a peer
+            # invalidated it with a changed signature): drop the mapping
+            # and re-announce the tensor in full next cycle
+            name = self._neg_cache_ids.pop(cid, None)
+            if name is not None:
+                self._neg_cache.pop(name, None)
+                if name in self._negotiated_pending:
+                    self._reannounce.add(name)
         if resp.params and jax.process_index() != 0:
             # mirror rank 0's (possibly autotuned) knobs; fusion decisions
             # happen at the coordinator, so adoption timing is free
@@ -701,6 +751,7 @@ class EagerCoordinator:
         return executed_bytes
 
     def _fail_pending_negotiated(self, exc):
+        self._reannounce.clear()
         with self._queue_lock:
             pending = list(self._negotiated_pending.values()) + \
                 list(self._queue)

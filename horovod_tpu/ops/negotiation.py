@@ -28,6 +28,7 @@ autotuner parameters ride every response (the reference broadcasts them
 with a custom MPI struct, parameter_manager.cc:66-81).
 """
 
+import collections
 import os
 import socketserver
 import threading
@@ -76,8 +77,66 @@ class EntryMeta:
         return a == b
 
 
+def encode_hits(ids):
+    """Compactly encode a set of cache ids (the response-cache bypass's
+    per-cycle announcement, reference bit-vector sync
+    response_cache.cc:317-354). Two encodings, smaller one wins: a
+    bitset (1 bit/id — dense steady state, ~n/8 bytes for n tensors)
+    or sorted varint deltas (~1-2 bytes/id — robust when ids are sparse
+    after heavy churn). First byte tags the encoding."""
+    if not ids:
+        return b""
+    ids = sorted(ids)
+    out = bytearray()
+    prev = -1
+    for i in ids:
+        d = i - prev
+        prev = i
+        while True:
+            out.append((d & 0x7F) | (0x80 if d > 0x7F else 0))
+            d >>= 7
+            if not d:
+                break
+    varints = bytes(out)
+    # only build the bitset when it can win: its size is max_id/8, which
+    # after id churn can dwarf the hit count (ids are never reused)
+    nbytes = ids[-1] // 8 + 1
+    if nbytes <= len(varints):
+        buf = bytearray(nbytes)
+        for i in ids:
+            buf[i >> 3] |= 1 << (i & 7)
+        return b"\x00" + bytes(buf)
+    return b"\x01" + varints
+
+
+def decode_hits(data):
+    if not data:
+        return []
+    tag, body = data[0], data[1:]
+    ids = []
+    if tag == 0:
+        for byte_i, byte in enumerate(body):
+            while byte:
+                low = byte & -byte
+                ids.append((byte_i << 3) + low.bit_length() - 1)
+                byte &= byte - 1
+        return ids
+    cur = shift = 0
+    prev = -1
+    for b in body:
+        cur |= (b & 0x7F) << shift
+        if b & 0x80:
+            shift += 7
+        else:
+            prev += cur
+            ids.append(prev)
+            cur = shift = 0
+    return ids
+
+
 class CycleRequest:
-    def __init__(self, rank, entries, ack, shutdown=False, req_id=0):
+    def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
+                 hits=b""):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
@@ -87,25 +146,34 @@ class CycleRequest:
         # recorded (a popped-and-resubmitted name would otherwise create
         # a ghost table row no other rank ever completes)
         self.req_id = req_id
+        # response-cache hits: encode_hits() of the cache ids this worker
+        # re-submits unchanged — the steady-state bypass of full
+        # EntryMeta uploads (reference RunBypass,
+        # operations.cc:1168-1215)
+        self.hits = hits
 
 
 class NegotiatedResponse:
     """One unit of agreed work (reference Response, message.h:130)."""
 
-    __slots__ = ("kind", "op", "names", "error")
+    __slots__ = ("kind", "op", "names", "error", "cache_ids")
     EXECUTE = "execute"
     ERROR = "error"
 
-    def __init__(self, kind, op, names, error=None):
+    def __init__(self, kind, op, names, error=None, cache_ids=None):
         self.kind = kind
         self.op = op
         self.names = names  # >1 names = fused allreduce
         self.error = error
+        # cache ids assigned to `names` (parallel list) on EXECUTE —
+        # riding the seq-ordered response log means every rank learns
+        # each assignment at the same point in its apply order
+        self.cache_ids = cache_ids
 
 
 class CycleResponse:
     def __init__(self, base_seq, responses, params, shutdown,
-                 stale_ack=False):
+                 stale_ack=False, unknown_ids=()):
         self.base_seq = base_seq      # seq of responses[0]
         self.responses = responses    # list[NegotiatedResponse]
         self.params = params          # (fusion_threshold, cycle_time_ms)
@@ -114,6 +182,20 @@ class CycleResponse:
         # never catch up and must fail its pending work (see
         # _prune_acknowledged's cap)
         self.stale_ack = stale_ack
+        # cache ids the requester announced as hits that this coordinator
+        # does not hold (evicted, or invalidated by another rank's
+        # changed-signature resubmission): the worker drops its mapping
+        # and re-announces those tensors with full metas
+        self.unknown_ids = tuple(unknown_ids)
+
+
+def _meta_identical(a, b):
+    """Exact equality of every negotiated parameter — the cache-hit
+    contract (stricter than agrees_with, which allows allgather dim-0
+    variance: a hit asserts the tensor is byte-for-byte re-describable
+    by the cached meta)."""
+    return (a.name, a.op, a.dtype, a.shape, a.root_rank, a.average) == \
+        (b.name, b.op, b.dtype, b.shape, b.root_rank, b.average)
 
 
 class _TableRow:
@@ -148,6 +230,15 @@ class CoordinatorService(network.BasicService):
         self._seen_req = {}       # rank -> last processed request id
         self._shutdown = False
         self._ports = ports
+        # Response cache (response_cache.h:43-92): names that EXECUTEd get
+        # a monotonically increasing cache id; a steady-state resubmission
+        # is one bit on the wire instead of a full EntryMeta. Ids are
+        # never reused — a stale hit after churn decodes as unknown, not
+        # as a silent alias to a different tensor. LRU-bounded by
+        # HOROVOD_CACHE_CAPACITY (0 disables caching entirely).
+        self._cache = collections.OrderedDict()  # id -> EntryMeta
+        self._cache_id_of = {}                   # name -> id
+        self._next_cache_id = 0
         super().__init__(SERVICE_NAME, key)
 
     # bind to one of the agreed candidate ports instead of an ephemeral
@@ -173,9 +264,26 @@ class CoordinatorService(network.BasicService):
             with self._lock:
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
+                # Hits resolve ONLY on the first processing of a request
+                # id. A deduped retry must not rescan: its hits were
+                # already applied, and an id evicted/invalidated since
+                # would scan as unknown — making the worker re-announce a
+                # name that may already be negotiated away, the exact
+                # ghost-row hazard the req_id dedupe exists to prevent.
+                # (If the unknowns themselves were lost with the first
+                # response, the worker's next hit under a NEW req_id
+                # rediscovers them.)
+                unknown = []
                 if self._seen_req.get(req.rank) != req.req_id:
                     self._seen_req[req.rank] = req.req_id
                     self._submit(req.rank, req.entries)
+                    for cid in decode_hits(req.hits):
+                        meta = self._cache.get(cid)
+                        if meta is None:
+                            unknown.append(cid)
+                        else:
+                            self._cache.move_to_end(cid)
+                            self._submit(req.rank, [meta])
                 self._negotiate()
                 # the shutdown flag is set AFTER this request's negotiate:
                 # work that became ready in the departing rank's final
@@ -192,7 +300,8 @@ class CoordinatorService(network.BasicService):
                     self._base_seq + start, list(self._responses[start:]),
                     (self._config.fusion_threshold,
                      self._config.cycle_time_ms),
-                    self._shutdown, stale_ack=stale)
+                    self._shutdown, stale_ack=stale,
+                    unknown_ids=unknown)
         raise NotImplementedError(req)
 
     # retained-response cap: a rank that crashed (or never reaches the
@@ -225,6 +334,17 @@ class CoordinatorService(network.BasicService):
 
     def _submit(self, rank, entries):
         for meta in entries:
+            # a full meta for a cached name whose parameters changed
+            # invalidates the id (shape change mid-run, e.g. a ragged
+            # last batch): peers still holding the old id get it back as
+            # unknown and re-announce (response_cache.cc invalidation)
+            cid = self._cache_id_of.get(meta.name)
+            if cid is not None:
+                cached = self._cache.get(cid)
+                if cached is not None and cached is not meta and \
+                        not _meta_identical(cached, meta):
+                    del self._cache[cid]
+                    del self._cache_id_of[meta.name]
             row = self._table.get(meta.name)
             if row is None:
                 row = self._table[meta.name] = _TableRow()
@@ -242,6 +362,11 @@ class CoordinatorService(network.BasicService):
                 ready.append(name)
         if not ready:
             return
+        # one O(n) rebuild instead of per-name list.remove() — at 1000
+        # ready gradients the removes alone are ~10^6 element shifts per
+        # negotiation, a measured control-plane hot spot
+        ready_set = set(ready)
+        self._order = [n for n in self._order if n not in ready_set]
         if self._shutdown:
             # a rank has left: an EXECUTE now would strand the remaining
             # ranks inside a collective the departed rank never runs
@@ -249,7 +374,6 @@ class CoordinatorService(network.BasicService):
             # operations.cc:1101-1122). Fail the work instead.
             for name in ready:
                 row = self._table.pop(name)
-                self._order.remove(name)
                 op = next(iter(row.metas.values())).op
                 self._responses.append(NegotiatedResponse(
                     NegotiatedResponse.ERROR, op, [name],
@@ -259,7 +383,6 @@ class CoordinatorService(network.BasicService):
         checked = []
         for name in ready:
             row = self._table.pop(name)
-            self._order.remove(name)
             base = row.metas[0]
             bad = [(r, m) for r, m in sorted(row.metas.items())
                    if not base.agrees_with(m)]
@@ -298,14 +421,45 @@ class CoordinatorService(network.BasicService):
         for i, (name, meta) in enumerate(checked):
             if meta.op != ALLREDUCE:
                 self._responses.append(NegotiatedResponse(
-                    NegotiatedResponse.EXECUTE, meta.op, [name]))
+                    NegotiatedResponse.EXECUTE, meta.op, [name],
+                    cache_ids=self._assign_cache_ids([(name, meta)])))
                 continue
             members = anchors.get(i)
             if members is None:  # emitted with an earlier anchor
                 continue
+            named = [checked[j] for j in members]
             self._responses.append(NegotiatedResponse(
                 NegotiatedResponse.EXECUTE, ALLREDUCE,
-                [checked[j][0] for j in members]))
+                [n for n, _ in named],
+                cache_ids=self._assign_cache_ids(named)))
+
+    def _assign_cache_ids(self, named_metas):
+        """Give each EXECUTEd name a cache id (new names and
+        changed-signature names get fresh ids; unchanged names keep
+        theirs, LRU-touched). Returns the parallel id list, or None when
+        caching is disabled (HOROVOD_CACHE_CAPACITY=0)."""
+        cap = int(getattr(self._config, "cache_capacity", 0) or 0)
+        if cap <= 0:
+            return None
+        ids = []
+        for name, meta in named_metas:
+            cid = self._cache_id_of.get(name)
+            if cid is not None and cid in self._cache and \
+                    _meta_identical(self._cache[cid], meta):
+                self._cache.move_to_end(cid)
+            else:
+                if cid is not None:
+                    self._cache.pop(cid, None)
+                cid = self._next_cache_id
+                self._next_cache_id += 1
+                self._cache[cid] = meta
+                self._cache_id_of[name] = cid
+                while len(self._cache) > cap:
+                    old_id, old_meta = self._cache.popitem(last=False)
+                    if self._cache_id_of.get(old_meta.name) == old_id:
+                        del self._cache_id_of[old_meta.name]
+            ids.append(cid)
+        return ids
 
     def _stall_scan(self):
         warn = self._config.stall_warning_time_seconds
@@ -399,10 +553,10 @@ class NegotiationWorker:
                         f"{addresses} after {start_timeout_s}s") from last
                 time.sleep(0.2)
 
-    def cycle(self, entries, ack, shutdown=False, req_id=0):
+    def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b""):
         return self._client.request(
             CycleRequest(self._rank, entries, ack, shutdown,
-                         req_id=req_id))
+                         req_id=req_id, hits=hits))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

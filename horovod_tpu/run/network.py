@@ -44,6 +44,13 @@ class Wire:
 
     def __init__(self, key):
         self._key = key
+        # cumulative on-wire payload bytes (digest + length prefix +
+        # body), observable by control-plane diagnostics and benches.
+        # Lock-guarded: one Wire is shared by all of a service's handler
+        # threads (BasicService._make_handler).
+        self.bytes_out = 0
+        self.bytes_in = 0
+        self._count_lock = threading.Lock()
 
     def write(self, obj, wfile):
         body = cloudpickle.dumps(obj)
@@ -51,11 +58,15 @@ class Wire:
         wfile.write(struct.pack("i", len(body)))
         wfile.write(body)
         wfile.flush()
+        with self._count_lock:
+            self.bytes_out += secret.DIGEST_LENGTH + 4 + len(body)
 
     def read(self, rfile):
         digest = rfile.read(secret.DIGEST_LENGTH)
         (length,) = struct.unpack("i", rfile.read(4))
         body = rfile.read(length)
+        with self._count_lock:
+            self.bytes_in += secret.DIGEST_LENGTH + 4 + length
         if not secret.check_digest(self._key, body, digest):
             raise RuntimeError(
                 "Security error: HMAC digest did not match the message.")
