@@ -510,9 +510,6 @@ struct PendingGen {             // rank-0 per-name negotiation state
 // FNV-1a over ndims + dims[first_dim:]: same byte count in a different
 // shape (e.g. [2,3] vs [3,2]) must NOT silently reinterpret data — the
 // reference errors on shape mismatch (operations.cc ConstructResponse).
-// FNV-1a over ndims + dims[first_dim:]: same byte count in a different
-// shape (e.g. [2,3] vs [3,2]) must NOT silently reinterpret data — the
-// reference errors on shape mismatch (operations.cc ConstructResponse).
 // Allgather hashes from first_dim=1 (dim0 may differ per rank).
 static uint64_t shape_digest_dims(int ndims, const int64_t* dims) {
   uint64_t h = 1469598103934665603ull;

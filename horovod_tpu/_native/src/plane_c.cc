@@ -93,6 +93,11 @@ HVDPLANE_EXPORT long long hvd_plane_allreduce_async(const char* name, void* data
                                     int average, const int64_t* dims,
                                     int ndims) {
   if (!hvd_plane_initialized()) return -1;
+  // averaging an integer reduction would silently truncate (scale_buf is
+  // a float-path op): reject at the API boundary instead of relying on
+  // every frontend to pre-filter
+  if (average && (dtype == hvdplane::I32 || dtype == hvdplane::I64))
+    return -1;
   hvdplane::Entry e;
   e.op = hvdplane::ALLREDUCE;
   e.dtype = static_cast<uint32_t>(dtype);

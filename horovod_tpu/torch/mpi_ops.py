@@ -174,7 +174,19 @@ def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name=name))
 
 
+def _check_broadcast_root(root_rank):
+    """Same-route error surface: the eager core raises on an
+    out-of-range root (ops/eager.py enqueue); the native plane would
+    instead have every rank block in the ring recv until the IO stall
+    kills the plane. Validate before choosing a route."""
+    if not 0 <= root_rank < size():
+        raise ValueError(
+            f"Invalid root_rank {root_rank} for broadcast: must be in "
+            f"[0, {size()}).")
+
+
 def broadcast_async(tensor, root_rank=0, name=None):
+    _check_broadcast_root(root_rank)
     if _native_route(tensor, average=False):
         from . import native as _nat
         staging = tensor.detach().clone().contiguous()
@@ -192,6 +204,7 @@ def broadcast_async(tensor, root_rank=0, name=None):
 
 
 def broadcast_async_(tensor, root_rank=0, name=None):
+    _check_broadcast_root(root_rank)
     if _native_route(tensor, average=False):
         from . import native as _nat
         h, staging = _nat.broadcast_async_(

@@ -173,6 +173,18 @@ def _dims(tensor):
     return arr, tensor.dim()
 
 
+def _check_handle(h, what):
+    """A negative handle means the plane rejected the enqueue (dead
+    plane or unsupported op). Raising here surfaces the error at submit
+    time; deferring it would leave poll(h) False forever (the C API
+    returns 0 for unknown handles) and only synchronize() would fail."""
+    if h < 0:
+        raise RuntimeError(
+            f"native plane rejected {what} at enqueue (plane not "
+            "initialized, shut down, or unsupported op/dtype combination)")
+    return h
+
+
 def allreduce_async_(tensor, average=True, name=""):
     """In-place ring allreduce on the tensor's own storage; returns a
     plane handle (wait with :func:`wait`). The tensor must stay alive
@@ -183,7 +195,7 @@ def allreduce_async_(tensor, average=True, name=""):
         name.encode(), ctypes.c_void_p(t.data_ptr()),
         t.numel() * t.element_size(), _DTYPE[t.dtype],
         1 if average else 0, dims, ndims)
-    return h, t
+    return _check_handle(h, f"allreduce '{name}'"), t
 
 
 def broadcast_async_(tensor, root_rank=0, name=""):
@@ -193,7 +205,7 @@ def broadcast_async_(tensor, root_rank=0, name=""):
         name.encode(), ctypes.c_void_p(t.data_ptr()),
         t.numel() * t.element_size(), _DTYPE[t.dtype], root_rank,
         dims, ndims)
-    return h, t
+    return _check_handle(h, f"broadcast '{name}'"), t
 
 
 def poll(handle):
@@ -215,7 +227,7 @@ def allgather_async(tensor, name=""):
     h = _state["cdll"].hvd_plane_allgather_async(
         name.encode(), ctypes.c_void_p(t.data_ptr()),
         t.numel() * t.element_size(), _DTYPE[t.dtype], dims, ndims)
-    return h, t
+    return _check_handle(h, f"allgather '{name}'"), t
 
 
 def wait_gather(handle, staging, timeout_s=None):

@@ -61,6 +61,15 @@ class TestTorchOps:
         with pytest.raises(ValueError, match="torch.Tensor"):
             thvd.allreduce(np.ones(3))
 
+    def test_broadcast_root_out_of_range_raises_on_any_route(self, thvd):
+        # route-independent error surface: the check runs before the
+        # native/bridge route split, so an out-of-range root can never
+        # reach the plane's ring recv (where no rank would act as root)
+        with pytest.raises(ValueError, match="root_rank"):
+            thvd.broadcast(torch.ones(3), root_rank=thvd.size())
+        with pytest.raises(ValueError, match="root_rank"):
+            thvd.broadcast_(torch.ones(3), root_rank=-1)
+
     def test_allreduce_bfloat16(self, thvd):
         # numpy has no bf16; the bridge rides fp32 and restores the dtype
         x = torch.randn(6, dtype=torch.bfloat16)
