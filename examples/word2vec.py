@@ -32,7 +32,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.ops.sparse import IndexedSlices, sparse_allreduce
+from horovod_tpu.ops.sparse import (IndexedSlices, grouped_sparse_allreduce,
+                                    sparse_allreduce)
 
 
 def parse_args():
@@ -47,6 +48,11 @@ def parse_args():
     p.add_argument("--steps", type=int, default=500)
     p.add_argument("--corpus-len", type=int, default=200_000)
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--eager", action="store_true",
+                   help="multi-process eager mode: sparse gradients ride "
+                        "grouped_sparse_allreduce, whose allgathers the "
+                        "negotiated coordinator fuses into single "
+                        "allgatherv collectives (launch under bin/hvdrun)")
     return p.parse_args()
 
 
@@ -93,19 +99,59 @@ def main():
     B, K = args.batch_size, args.num_negatives
     lr = args.lr * world  # reference scales LR by hvd.size()
 
+    def loss_fn(c_rows, pos_rows, neg_rows):
+        pos_logit = jnp.sum(c_rows * pos_rows, -1)            # [B]
+        neg_logit = jnp.einsum("bd,bkd->bk", c_rows, neg_rows)
+        return (-jnp.mean(jax.nn.log_sigmoid(pos_logit))
+                - jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg_logit), -1)))
+
+    if args.eager:
+        # Per-process eager training: local grads, then ONE grouped
+        # sparse allreduce per step — the coordinator fuses its six
+        # allgathers (3 float values + 3 int32 indices) into two
+        # allgatherv collectives, and after step 1 every announcement is
+        # a response-cache bit.
+        nproc = hvd.process_count()
+        lr = args.lr * nproc
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
+        proc_rng = np.random.RandomState(args.seed + hvd.process_rank())
+        t0 = time.time()
+        avg = None
+        for i in range(args.steps):
+            centers, contexts = skipgram_batches(corpus, args.window, B,
+                                                 proc_rng)
+            negs = proc_rng.randint(0, args.vocab_size, (B, K))
+            centers = jnp.asarray(centers)
+            contexts = jnp.asarray(contexts)
+            negs_j = jnp.asarray(negs)
+            loss, (g_c, g_pos, g_neg) = grad_fn(
+                emb[centers], ctx[contexts], ctx[negs_j])
+            g_emb, g_ctx_pos, g_ctx_neg = grouped_sparse_allreduce(
+                [IndexedSlices(g_c, centers, emb.shape),
+                 IndexedSlices(g_pos, contexts, ctx.shape),
+                 IndexedSlices(g_neg.reshape(B * K, -1),
+                               negs_j.reshape(B * K), ctx.shape)],
+                average=True, name="w2v")  # stable names → cache hits
+            emb = emb.at[g_emb.indices].add(-lr * g_emb.values)
+            ctx = ctx.at[g_ctx_pos.indices].add(-lr * g_ctx_pos.values)
+            ctx = ctx.at[g_ctx_neg.indices].add(-lr * g_ctx_neg.values)
+            loss = float(np.asarray(hvd.allreduce(
+                np.asarray(loss, np.float32), average=True)))
+            avg = loss if avg is None else 0.95 * avg + 0.05 * loss
+            if verbose and (i + 1) % max(1, args.steps // 10) == 0:
+                print(f"step {i + 1}: loss={avg:.4f}")
+        if verbose:
+            print(f"[eager x{nproc} procs] {args.steps} steps in "
+                  f"{time.time() - t0:.1f}s  final loss={avg:.4f}")
+        hvd.shutdown()
+        return
+
     def step(emb, ctx, center, context, negs):
         """One negative-sampling step on this worker's pairs; gradients are
         sparse rows, allreduced via the IndexedSlices allgather path."""
         c_rows = emb[center]                      # [B, D]
         pos_rows = ctx[context]                   # [B, D]
         neg_rows = ctx[negs]                      # [B, K, D]
-
-        def loss_fn(c_rows, pos_rows, neg_rows):
-            pos_logit = jnp.sum(c_rows * pos_rows, -1)            # [B]
-            neg_logit = jnp.einsum("bd,bkd->bk", c_rows, neg_rows)
-            loss = (-jnp.mean(jax.nn.log_sigmoid(pos_logit))
-                    - jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg_logit), -1)))
-            return loss
 
         loss, (g_c, g_pos, g_neg) = jax.value_and_grad(
             loss_fn, argnums=(0, 1, 2))(c_rows, pos_rows, neg_rows)

@@ -726,6 +726,10 @@ class EagerCoordinator:
                     entries,
                     lambda es: self._exec_fused_replicated_allreduce(
                         es, es[0].average))
+            elif r.op == ALLGATHER and len(entries) > 1:
+                executed_bytes += sum(_entry_nbytes(e) for e in entries)
+                self._finish_entries(
+                    entries, self._exec_fused_replicated_allgather)
             else:
                 executed_bytes += _entry_nbytes(entries[0])
                 self._finish_entries(
@@ -801,6 +805,70 @@ class EagerCoordinator:
             e.result = jnp.reshape(summed[offset:offset + n],
                                    np.shape(e.tensor))
             offset += n
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+
+    def _exec_fused_replicated_allgather(self, entries):
+        """Coordinator-fused multi-process allgatherv: ONE counts
+        exchange and ONE payload collective for the whole bucket
+        (Response::add_allgather_response fusion, message.h:172, with
+        the per-rank displacement math of
+        collective_operations.cc:68-134 / MPI_Allgatherv
+        mpi_operations.cc:86-173). Members may have different inner
+        shapes (flattened into the buffer) and per-rank first dims;
+        every process executes this identically because the bucket
+        composition rides the coordinator's seq-ordered response."""
+        eng = self._proc_engine
+        nproc = jax.process_count()
+        tl = self.timeline
+        names = [e.name for e in entries]
+        if tl:
+            for n in names:
+                tl.start_activity(n, timeline_mod.MEMCPY_IN_FUSION_BUFFER)
+        tensors = [jnp.asarray(e.tensor) for e in entries]
+        shapes = [t.shape for t in tensors]
+        inners = [s[1:] for s in shapes]
+        # scalars gather to [nproc] (rank-1 contract, same as unfused)
+        d0s = [s[0] if len(s) else 1 for s in shapes]
+        inner_sizes = np.asarray(
+            [int(np.prod(i, dtype=np.int64)) if len(i) else 1
+             for i in inners], np.int64)
+        flats = [jnp.reshape(t, (-1,)) for t in tensors]
+        local = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+                tl.start_activity(n, timeline_mod.ALLGATHER)
+        # one dim0-counts exchange for the whole bucket (the unfused
+        # path pays one per tensor)
+        counts = np.asarray(eng.allgather_stacked(
+            np.asarray(d0s, np.int32))).astype(np.int64)  # [nproc, k]
+        totals = (counts * inner_sizes[None, :]).sum(axis=1)
+        maxlen = int(totals.max())
+        if local.shape[0] < maxlen:
+            local = jnp.concatenate(
+                [local, jnp.zeros((maxlen - local.shape[0],), local.dtype)])
+        with jax.profiler.TraceAnnotation(
+                f"hvd.fused_allgather.x{len(entries)}"):
+            gathered = eng.allgather_stacked(local)  # [nproc, maxlen]
+        if tl:
+            for n in names:
+                tl.end_activity(n)
+                tl.start_activity(n, timeline_mod.MEMCPY_OUT_FUSION_BUFFER)
+        # un-fuse: rank p's chunk holds member m's rows at displacement
+        # sum_{j<m} counts[p,j]*inner_sizes[j]
+        for m, e in enumerate(entries):
+            pieces = []
+            for p in range(nproc):
+                off = int((counts[p, :m] * inner_sizes[:m]).sum())
+                n_el = int(counts[p, m]) * int(inner_sizes[m])
+                seg = gathered[p, off:off + n_el]
+                if len(shapes[m]):
+                    seg = jnp.reshape(
+                        seg, (int(counts[p, m]),) + tuple(inners[m]))
+                pieces.append(seg)
+            e.result = jnp.concatenate(pieces, axis=0)
         if tl:
             for n in names:
                 tl.end_activity(n)

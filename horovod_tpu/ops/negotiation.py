@@ -403,8 +403,10 @@ class CoordinatorService(network.BasicService):
         # Fusion: the same look-ahead dtype-bucketing planner (native
         # hvd_plan_buckets when built) that serves the jit path and the
         # eager stacked path — EntryMeta quacks like a leaf (shape/dtype).
-        # Partitioned by `average` first: sum and mean cannot share a
-        # fused buffer.
+        # Allreduces partition by `average` first (sum and mean cannot
+        # share a fused buffer); allgathers bucket by dtype alone and
+        # execute as one fused allgatherv with per-rank displacement
+        # math (Response::add_allgather_response, message.h:172).
         from . import fusion as fusion_mod
         threshold = self._config.fusion_threshold
         anchors = {}  # first checked-index of a bucket -> member indices
@@ -418,8 +420,18 @@ class CoordinatorService(network.BasicService):
             for b in buckets:
                 members = [idx[j] for j in b.indices]
                 anchors[members[0]] = members
+        # plan_buckets partitions by dtype internally, so all ready
+        # allgathers go through one planning call
+        idx = [i for i, (_, m) in enumerate(checked)
+               if m.op == ALLGATHER]
+        if idx:
+            buckets = fusion_mod.plan_buckets(
+                [checked[i][1] for i in idx], threshold)
+            for b in buckets:
+                members = [idx[j] for j in b.indices]
+                anchors[members[0]] = members
         for i, (name, meta) in enumerate(checked):
-            if meta.op != ALLREDUCE:
+            if meta.op not in (ALLREDUCE, ALLGATHER):
                 self._responses.append(NegotiatedResponse(
                     NegotiatedResponse.EXECUTE, meta.op, [name],
                     cache_ids=self._assign_cache_ids([(name, meta)])))
@@ -429,7 +441,7 @@ class CoordinatorService(network.BasicService):
                 continue
             named = [checked[j] for j in members]
             self._responses.append(NegotiatedResponse(
-                NegotiatedResponse.EXECUTE, ALLREDUCE,
+                NegotiatedResponse.EXECUTE, meta.op,
                 [n for n, _ in named],
                 cache_ids=self._assign_cache_ids(named)))
 
