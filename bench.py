@@ -1,11 +1,17 @@
 """Headline benchmark: ResNet-50 images/sec + flagship transformer MFU.
 
 Parity with the reference harness (examples/pytorch_synthetic_benchmark.py:
-ResNet-50, synthetic ImageNet-shaped data, 10 warmup batches, 10 iters x 10
-batches, reports img/sec). Baseline for vs_baseline is the published
-single-GPU Pascal P100 ResNet-50 fp32 throughput (~219 img/sec) underlying
-the reference's 512-GPU scaling chart (docs/benchmarks.md:6-7) — the
-per-worker number our per-chip number must beat.
+ResNet-50, synthetic ImageNet-shaped data, warmup batches then ~13 timed
+iters x 10 batches, reports img/sec). Baseline for vs_baseline is the
+published single-GPU Pascal P100 ResNet-50 fp32 throughput (~219 img/sec)
+underlying the reference's 512-GPU scaling chart (docs/benchmarks.md:6-7) —
+the per-worker number our per-chip number must beat.
+
+Drift-proofing (r5): the ResNet iteration blocks and the transformer
+windows are INTERLEAVED in one session (R,T,R,T,...) so the tunneled
+runtime's minute-scale drift is common-mode across both headline
+numbers, and each reports a paired spread bound (value_pm /
+ms_per_step_pm = half the range of its window means).
 
 The same line also carries the flagship transformer LM (GPT-2-small,
 Pallas flash attention, bf16, seq 1024): tokens/sec/chip and measured
@@ -244,9 +250,16 @@ def main():
     env_batch = os.environ.get("HVD_BENCH_BATCH")
     candidates = ([int(env_batch)] if env_batch else
                   [256, 128, 64] if on_tpu else [4])
-    warmup, iters, inner = (3, 10, 10) if on_tpu else (2, 3, 3)
+    # Same total measured batches as the r3/r4 protocol (10 iters x 10),
+    # but split into ROUNDS blocks interleaved with transformer windows
+    # so the tunneled runtime's session drift (measured ~2x minute to
+    # minute on eager paths, and the r3->r4 ResNet delta's suspect) is
+    # common-mode across both headline numbers, and each number carries
+    # a paired spread bound.
+    rounds = 3 if on_tpu else 1
+    warmup, iters_per_round, inner = (3, 4, 10) if on_tpu else (2, 3, 3)
 
-    rates = None
+    step = None
     batch = candidates[-1] * n_chips
     for cand in candidates:
         batch = cand * n_chips
@@ -261,8 +274,9 @@ def main():
             # hardware.)
             step, params, opt_state, batch_data = build_step(
                 "resnet50", mesh, batch, image_size)
-            rates = timed_rates(step, params, opt_state, batch_data, batch,
-                                warmup, iters, inner)
+            # compile + warmup outside every timed window
+            rates = timed_rates(step, params, opt_state, batch_data,
+                                batch, warmup, 1, inner)
             break
         except Exception as e:  # noqa: BLE001 — OOM fallback
             if cand == candidates[-1] or "RESOURCE_EXHAUSTED" not in str(e):
@@ -273,22 +287,58 @@ def main():
             jax.clear_caches()
             print(f"batch {cand}/chip OOM, trying smaller", file=sys.stderr)
 
-    img_sec_per_chip = float(np.mean(rates)) / n_chips
-
-    # free the ResNet step before compiling the transformer
-    step = params = opt_state = batch_data = None
-    jax.clear_caches()
+    # Transformer setup alongside the resident ResNet state (both fit a
+    # v5e; on OOM fall back to sequential-after-ResNet, losing only the
+    # interleaving, never the numbers).
+    tlm_window = tlm_meta = None
+    tlm_err = None
+    peak = _peak_flops(jax.devices()[0]) if on_tpu else None
+    from bench_common import setup_transformer_lm, transformer_lm_metrics
     try:
-        from bench_common import bench_transformer_lm
-        peak = _peak_flops(jax.devices()[0]) if on_tpu else None
-        tlm = bench_transformer_lm(on_tpu, peak_flops=peak)
+        tlm_window, tlm_meta = setup_transformer_lm(on_tpu)
+        tlm_window()  # compile + warmup
     except Exception as e:  # noqa: BLE001 — ResNet line must still print
-        print(f"transformer bench failed: {e}", file=sys.stderr)
-        tlm = {"error": str(e)[:200]}
+        print(f"transformer bench setup failed (will retry "
+              f"sequentially): {e}", file=sys.stderr)
+        tlm_window = None
+        tlm_err = str(e)
+
+    # Interleaved measurement: R-block, T-window, R-block, T-window, ...
+    r_rates, r_window_means, t_window_s = list(rates), [], []
+    for rd in range(rounds):
+        block = timed_rates(step, params, opt_state, batch_data, batch,
+                            1, iters_per_round, inner)
+        r_rates.extend(block)
+        r_window_means.append(float(np.mean(block)))
+        if tlm_window is not None:
+            try:
+                t_window_s.append(tlm_window())
+            except Exception as e:  # noqa: BLE001
+                print(f"transformer window failed: {e}", file=sys.stderr)
+                tlm_window = None
+                tlm_err = str(e)
+
+    img_sec_per_chip = float(np.mean(r_rates)) / n_chips
+    value_pm = ((max(r_window_means) - min(r_window_means)) / 2 / n_chips
+                if len(r_window_means) > 1 else 0.0)
+
+    if t_window_s:
+        tlm = transformer_lm_metrics(t_window_s, tlm_meta, peak_flops=peak)
+    else:
+        # sequential fallback: free ResNet first, then bench alone
+        step = params = opt_state = batch_data = None
+        jax.clear_caches()
+        try:
+            from bench_common import bench_transformer_lm
+            tlm = bench_transformer_lm(on_tpu, peak_flops=peak)
+        except Exception as e:  # noqa: BLE001
+            print(f"transformer bench failed: {e}", file=sys.stderr)
+            tlm = {"error": str(tlm_err or e)[:200]}
 
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_sec_per_chip, 2),
+        "value_pm": round(value_pm, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(
             img_sec_per_chip / BASELINE_IMG_PER_SEC_PER_WORKER, 3),

@@ -158,48 +158,82 @@ def build_transformer_step(mesh, batch, seq, cfg=None, on_tpu=True,
     return step, params, opt_state, toks, cfg
 
 
-def bench_transformer_lm(on_tpu, peak_flops=None):
-    """Timed flagship-transformer training window (the canonical source
-    of the tokens/sec/chip + MFU numbers in bench.py's JSON line and
+def setup_transformer_lm(on_tpu):
+    """Build the flagship-transformer bench (the canonical source of the
+    tokens/sec/chip + MFU numbers in bench.py's JSON line and
     docs/benchmarks.md — keep single-sourced so harnesses cannot drift).
 
     Uses the device-side multi-step loop (trainer.make_gspmd_multi_step)
     so host dispatch — ~3-5 ms per call through a remote-attached
     runtime — is amortized out of the measurement; the loop scans over a
     stacked [n_steps, batch, seq] token array, a real optimizer update
-    per inner step. Returns a metrics dict."""
+    per inner step.
+
+    Returns (window_fn, meta): window_fn() runs one timed window and
+    returns seconds/step; the first call includes compile (callers
+    treat it as warmup). Exposing windows individually lets bench.py
+    INTERLEAVE them with the ResNet windows so session drift is
+    common-mode across both headline numbers."""
     from horovod_tpu.parallel import mesh as mesh_mod
 
     if on_tpu:
         # batch 16 is the measured per-chip sweet spot (r4: 0.632 MFU vs
         # 0.603 at batch 8 and 0.58 at batch 32, docs/benchmarks.md)
-        batch_per_chip, seq, inner, windows = 16, 1024, 10, 3
+        batch_per_chip, seq, inner = 16, 1024, 10
     else:  # CI smoke on CPU: tiny everything, no MFU claim
-        batch_per_chip, seq, inner, windows = 2, 64, 2, 1
+        batch_per_chip, seq, inner = 2, 64, 2
 
     n = hvd.size()
     mesh = mesh_mod.build_mesh(dp=n)
     batch = batch_per_chip * n
     step, params, opt_state, toks, cfg = build_transformer_step(
         mesh, batch, seq, on_tpu=on_tpu, n_steps=inner)
+    live = {"params": params, "opt": opt_state}
 
-    params, opt_state, loss = step(params, opt_state, toks)
-    float(loss)  # scalar read = true barrier on remote-attached runtimes
-    best = float("inf")
-    for _ in range(windows):
+    def window():
         t0 = time.perf_counter()
-        params, opt_state, loss = step(params, opt_state, toks)
-        float(loss)
-        best = min(best, (time.perf_counter() - t0) / inner)
-    tps_chip = batch * seq / best / n
+        live["params"], live["opt"], loss = step(live["params"],
+                                                 live["opt"], toks)
+        float(loss)  # scalar read = true barrier on remote runtimes
+        return (time.perf_counter() - t0) / inner
 
-    flops_per_token = transformer_matmul_flops_per_token(cfg, seq)
+    meta = {"batch": batch, "batch_per_chip": batch_per_chip, "seq": seq,
+            "inner": inner, "cfg": cfg, "n": n,
+            "model": f"gpt2-small-{'tpu-flash' if on_tpu else 'tiny-smoke'}"}
+    return window, meta
+
+
+def transformer_lm_metrics(window_s, meta, peak_flops=None):
+    """Fold per-window seconds/step into the bench's metrics dict.
+    tokens_per_sec_per_chip/mfu keep the best-window convention (r3/r4
+    comparability); the paired-measurement bound rides alongside as
+    ms_per_step_mean/pm so cross-round deltas can be judged against
+    session drift."""
+    best = min(window_s)
+    mean = sum(window_s) / len(window_s)
+    pm = (max(window_s) - min(window_s)) / 2
+    tps_chip = meta["batch"] * meta["seq"] / best / meta["n"]
+    flops_per_token = transformer_matmul_flops_per_token(
+        meta["cfg"], meta["seq"])
     mfu = (tps_chip * flops_per_token / peak_flops) if peak_flops else None
     return {
-        "model": f"gpt2-small-{'tpu-flash' if on_tpu else 'tiny-smoke'}",
+        "model": meta["model"],
         "tokens_per_sec_per_chip": round(tps_chip, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "seq_len": seq,
-        "batch_per_chip": batch_per_chip,
+        "seq_len": meta["seq"],
+        "batch_per_chip": meta["batch_per_chip"],
         "ms_per_step": round(best * 1e3, 2),
+        "ms_per_step_mean": round(mean * 1e3, 2),
+        "ms_per_step_pm": round(pm * 1e3, 2),
+        "windows": len(window_s),
     }
+
+
+def bench_transformer_lm(on_tpu, peak_flops=None):
+    """Sequential-windows convenience wrapper over setup/window/metrics
+    (bench.py interleaves the windows itself)."""
+    window, meta = setup_transformer_lm(on_tpu)
+    window()  # compile + warmup
+    windows = 3 if on_tpu else 1
+    return transformer_lm_metrics([window() for _ in range(windows)],
+                                  meta, peak_flops=peak_flops)

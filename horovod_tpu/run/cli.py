@@ -20,6 +20,7 @@ analogue of MPI_Init inside the background thread (operations.cc:869-888).
 import argparse
 import base64
 import os
+import signal
 import socket
 import sys
 import time
@@ -182,8 +183,7 @@ def run_command_on_hosts(host_list, command, coordinator_addr, settings,
         pending = set(range(len(procs)))
         while pending:
             if cancel_event is not None and cancel_event.is_set():
-                for j in sorted(pending):
-                    exec_util.terminate_tree(procs[j])
+                exec_util.terminate_trees([procs[j] for j in sorted(pending)])
                 exit_code = exit_code or 130
                 break
             for i in sorted(pending):
@@ -195,16 +195,17 @@ def run_command_on_hosts(host_list, command, coordinator_addr, settings,
                     exit_code = rc
                     # One failed worker aborts the job, as an MPI abort
                     # would (reference semantics of mpirun).
-                    for j in sorted(pending):
-                        exec_util.terminate_tree(procs[j])
+                    exec_util.terminate_trees(
+                        [procs[j] for j in sorted(pending)])
                     pending.clear()
                     break
             time.sleep(0.2)
     except BaseException:
-        # Spawn failure mid-loop or Ctrl-C: never leak already-started
-        # workers waiting on a coordinator that will not form.
-        for proc in procs:
-            exec_util.terminate_tree(proc)
+        # Spawn failure mid-loop, Ctrl-C, or a supervisor's SIGTERM
+        # (rerouted to SystemExit in main): never leak already-started
+        # workers — parallel group kill, so the whole cleanup fits
+        # inside any reasonable supervisor kill-grace window.
+        exec_util.terminate_trees(procs)
         if isinstance(sys.exc_info()[1], KeyboardInterrupt):
             exit_code = 130
         else:
@@ -253,8 +254,27 @@ def main(argv=None):
     if args.verbose:
         print(f"hvdrun: launching {args.num_proc} processes on "
               f"{len(host_list)} host(s); coordinator {coordinator_addr}")
+    # Workers run in their OWN process groups (exec_util.safe_execute
+    # start_new_session), so a SIGTERM to hvdrun alone would strand them
+    # training headless — exactly how a supervisor (run/elastic.py) or a
+    # scheduler stops a job. Convert it to SystemExit so
+    # run_command_on_hosts' cleanup path terminates every worker tree
+    # before exiting. Main-thread only; library callers (launch.run)
+    # drive cancellation via cancel_event instead.
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda signum, frame: sys.exit(143))
+    except ValueError:
+        pass  # not the main thread
+    # Export the per-job secret to every worker: the negotiated eager
+    # control plane derives its HMAC key from it (ops/negotiation.py
+    # control_key) — without it workers fall back to the strict
+    # same-order contract (launch.py run() exports it the same way).
+    key_b64 = base64.b64encode(settings.key).decode("ascii")
     sys.exit(run_command_on_hosts(host_list, args.command, coordinator_addr,
-                                  settings, output_dir=args.output_dir))
+                                  settings, output_dir=args.output_dir,
+                                  extra_env={secret.HVD_SECRET_KEY:
+                                             key_b64}))
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ import shlex
 import signal
 import subprocess
 import threading
+import time
 
 # Env vars never forwarded to workers (reference env.py IGNORE_REGEX).
 _IGNORE = re.compile(r"^(BASH_FUNC|OLDPWD$|PWD$|SHLVL$|_$|LS_COLORS$)")
@@ -65,6 +66,33 @@ def safe_execute(command, env=None, stdout=None, stderr=None,
             on_exit(index, rc)
         threading.Thread(target=watch, daemon=True).start()
     return proc
+
+
+def terminate_trees(procs, grace_s=1.5):
+    """SIGTERM every process group at once, share ONE grace window, then
+    SIGKILL survivors. The parallel form of terminate_tree for a worker
+    fleet: serial per-proc graces can add up past a supervisor's own
+    kill window, and some runtimes swallow SIGTERM entirely (jax's
+    distributed preemption notifier), so the SIGKILL pass must be
+    reached promptly."""
+    live = [p for p in procs if p is not None and p.poll() is None]
+    for p in live:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+        except Exception:  # noqa: BLE001 — already exited / reaped
+            pass
+    deadline = time.monotonic() + grace_s
+    for p in live:
+        try:
+            p.wait(timeout=max(0.0, deadline - time.monotonic()))
+        except Exception:  # noqa: BLE001 — still running
+            pass
+    for p in live:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except Exception:  # noqa: BLE001 — lost the race, fine
+                pass
 
 
 def terminate_tree(proc, grace_s=5.0):
