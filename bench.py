@@ -274,9 +274,11 @@ def main():
             # hardware.)
             step, params, opt_state, batch_data = build_step(
                 "resnet50", mesh, batch, image_size)
-            # compile + warmup outside every timed window
-            rates = timed_rates(step, params, opt_state, batch_data,
-                                batch, warmup, 1, inner)
+            # compile + warmup outside every timed window; the step
+            # donates params/opt_state, so every call threads them
+            rates, params, opt_state = timed_rates(
+                step, params, opt_state, batch_data, batch, warmup, 1,
+                inner, return_state=True)
             break
         except Exception as e:  # noqa: BLE001 — OOM fallback
             if cand == candidates[-1] or "RESOURCE_EXHAUSTED" not in str(e):
@@ -306,8 +308,9 @@ def main():
     # Interleaved measurement: R-block, T-window, R-block, T-window, ...
     r_rates, r_window_means, t_window_s = list(rates), [], []
     for rd in range(rounds):
-        block = timed_rates(step, params, opt_state, batch_data, batch,
-                            1, iters_per_round, inner)
+        block, params, opt_state = timed_rates(
+            step, params, opt_state, batch_data, batch, 1,
+            iters_per_round, inner, return_state=True)
         r_rates.extend(block)
         r_window_means.append(float(np.mean(block)))
         if tlm_window is not None:

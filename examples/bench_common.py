@@ -56,14 +56,19 @@ def build_step(model_name, mesh, batch, image_size, fp16_allreduce=False,
 
 def timed_rates(step, params, opt_state, batch_data, batch,
                 num_warmup_batches, num_iters, num_batches_per_iter,
-                on_iter=None, updates_per_step=1):
+                on_iter=None, updates_per_step=1, return_state=False):
     """Run the reference timing protocol; returns per-iteration total
     img/sec. At least one warmup step always runs so trace+compile of the
     jitted step can never land inside the timed region (a compile-polluted
     first iteration would silently wreck the reported rate). The sync
     barrier is a scalar device-to-host read — on remote-attached runtimes
     block_until_ready can return before execution completes
-    (docs/benchmarks.md)."""
+    (docs/benchmarks.md).
+
+    With return_state=True, returns (rates, params, opt_state) — REQUIRED
+    for repeated calls on the same step: the jitted step donates its
+    params/opt_state buffers, so re-passing the originals after one call
+    is a donated-buffer use error."""
     for _ in range(max(1, num_warmup_batches)):
         params, opt_state, loss = step(params, opt_state, batch_data)
     float(loss)  # scalar transfer: a sync barrier on every backend
@@ -79,6 +84,8 @@ def timed_rates(step, params, opt_state, batch_data, batch,
         rates.append(rate)
         if on_iter is not None:
             on_iter(i, rate)
+    if return_state:
+        return rates, params, opt_state
     return rates
 
 

@@ -29,10 +29,13 @@
 #include <deque>
 #include <functional>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
+
+#include "shm_ring.h"
 
 // Builds compile with -fvisibility=hidden so the inline Plane singleton
 // is NOT exported as STB_GNU_UNIQUE — without that, a process loading
@@ -301,6 +304,54 @@ static bool exchange(int send_fd, const char* sbuf, size_t slen,
   return true;
 }
 
+// Machine identity for same-host detection: kernel boot id + IPC
+// namespace. Source-IP comparison would false-positive behind NAT
+// (distinct hosts, one apparent address) and false-negative on
+// multi-homed hosts; and two containers on one kernel share a boot id
+// but NOT /dev/shm, so the IPC namespace must match too. Hostname is
+// the fallback when /proc is unavailable.
+static std::string machine_id() {
+  std::string id;
+  FILE* f = ::fopen("/proc/sys/kernel/random/boot_id", "r");
+  if (f) {
+    char buf[64] = {0};
+    size_t n = ::fread(buf, 1, sizeof(buf) - 1, f);
+    ::fclose(f);
+    while (n && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = 0;
+    id.assign(buf, n);
+  }
+  if (id.empty()) {
+    char host[256] = {0};
+    ::gethostname(host, sizeof(host) - 1);
+    id = host;
+  }
+  char ns[64] = {0};
+  ssize_t n = ::readlink("/proc/self/ns/ipc", ns, sizeof(ns) - 1);
+  if (n > 0) id += "." + std::string(ns, static_cast<size_t>(n));
+  return id;
+}
+
+// Run-unique token for shm object names: a stale object from a crashed
+// job with the same rendezvous port must never alias this run's rings
+// (the consumer could map the dead ring and stall the first collective
+// for the full IO window).
+static std::string random_nonce() {
+  unsigned char b[8];
+  FILE* f = ::fopen("/dev/urandom", "r");
+  size_t got = f ? ::fread(b, 1, sizeof(b), f) : 0;
+  if (f) ::fclose(f);
+  if (got != sizeof(b)) {
+    uint64_t v = static_cast<uint64_t>(::getpid()) ^
+        static_cast<uint64_t>(std::chrono::steady_clock::now()
+                                  .time_since_epoch().count());
+    std::memcpy(b, &v, sizeof(v));
+  }
+  char out[17];
+  for (int i = 0; i < 8; ++i)
+    std::snprintf(out + 2 * i, 3, "%02x", b[i]);
+  return std::string(out, 16);
+}
+
 static void set_nodelay(int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -552,6 +603,8 @@ class Plane {
     next_fd_ = prev_fd_ = -1;
     for (int& fd : wake_pipe_)
       if (fd >= 0) { ::close(fd); fd = -1; }
+    shm_next_.reset();
+    shm_prev_.reset();
   }
 
   bool init_inner(int rank, int size, const std::string& coord_host,
@@ -570,6 +623,8 @@ class Plane {
 
     std::vector<std::string> hosts(size_);
     std::vector<uint16_t> ports(size_);
+    std::vector<std::string> mids(size_);  // machine ids (same-host test)
+    std::string nonce;                     // run-unique shm name token
 
     if (rank_ == 0) {
       uint16_t cp = coord_port;
@@ -610,14 +665,20 @@ class Plane {
         ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
         hosts[r] = ip;
         ports[r] = static_cast<uint16_t>(hello.hdr.b);
+        mids[r].assign(hello.payload.begin(), hello.payload.end());
         ctrl_fds_[r] = cfd;
         ++joined;
       }
       ::close(lfd);
-      // endpoint table: "host:port\n" per rank
-      std::string table;
+      mids[0] = machine_id();
+      nonce = random_nonce();
+      // endpoint table: nonce line, then "host:port\n" per rank, then
+      // one machine-id line per rank
+      std::string table = nonce + "\n";
       for (int r = 0; r < size_; ++r)
         table += hosts[r] + ":" + std::to_string(ports[r]) + "\n";
+      for (int r = 0; r < size_; ++r)
+        table += mids[r] + "\n";
       for (int r = 1; r < size_; ++r)
         if (!send_msg(ctrl_fds_[r], &ctrl_send_mu_, ENDPOINTS, "", 0, 0,
                       table.data(), table.size())) {
@@ -629,8 +690,10 @@ class Plane {
       if (ctrl0_fd_ < 0) { ::close(ring_listen); return false; }
       set_nodelay(ctrl0_fd_);
       set_recv_deadline(ctrl0_fd_, deadline);
+      std::string mid = machine_id();
       if (!send_msg(ctrl0_fd_, &ctrl_send_mu_, HELLO, "",
-                    static_cast<uint64_t>(rank_), ring_port)) {
+                    static_cast<uint64_t>(rank_), ring_port,
+                    mid.data(), mid.size())) {
         ::close(ring_listen);
         return false;
       }
@@ -643,12 +706,20 @@ class Plane {
       }
       std::string table(eps.payload.begin(), eps.payload.end());
       size_t pos = 0;
+      size_t nl = table.find('\n', pos);
+      nonce = table.substr(pos, nl - pos);
+      pos = nl + 1;
       for (int r = 0; r < size_; ++r) {
-        size_t nl = table.find('\n', pos);
+        nl = table.find('\n', pos);
         size_t colon = table.rfind(':', nl);
         hosts[r] = table.substr(pos, colon - pos);
         ports[r] = static_cast<uint16_t>(
             std::stoi(table.substr(colon + 1, nl - colon - 1)));
+        pos = nl + 1;
+      }
+      for (int r = 0; r < size_; ++r) {
+        nl = table.find('\n', pos);
+        mids[r] = table.substr(pos, nl - pos);
         pos = nl + 1;
       }
     }
@@ -674,6 +745,31 @@ class Plane {
       return false;               // rank 0 drains local_ready_, workers
                                   // drain the READY outbox)
 
+    // 3. same-host ring edges upgrade to shared memory: both ends of an
+    // edge evaluate the SAME predicate (machine-id equality) over the
+    // SAME endpoint table, so they agree without extra messages. The
+    // producer (edge rank -> next) creates the object under the run
+    // nonce, the consumer opens-with-deadline and unlinks. A
+    // create/open failure fails init on both ends (the consumer's
+    // deadline covers the asymmetric case), so the frontends fall back
+    // together. HVD_PLANE_SHM=0 forces TCP everywhere.
+    const char* shm_env = ::getenv("HVD_PLANE_SHM");
+    if (!(shm_env && shm_env[0] == '0')) {
+      int prev = (rank_ - 1 + size_) % size_;
+      std::string base = "/hvdplane." + nonce + ".";
+      if (!mids[rank_].empty() && mids[rank_] == mids[next]) {
+        shm_next_.reset(new hvdshm::Channel());
+        if (!shm_next_->create(base + std::to_string(rank_)))
+          return false;
+      }
+      if (!mids[rank_].empty() && mids[prev] == mids[rank_]) {
+        shm_prev_.reset(new hvdshm::Channel());
+        if (!shm_prev_->open_with_deadline(base + std::to_string(prev),
+                                           timeout_s))
+          return false;
+      }
+    }
+
     // bootstrap over: control reads go back to blocking (the comm loop
     // polls before each recv, so a healthy peer never stalls it)
     if (ctrl0_fd_ >= 0) clear_recv_deadline(ctrl0_fd_);
@@ -698,6 +794,10 @@ class Plane {
       if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
     if (next_fd_ >= 0) ::shutdown(next_fd_, SHUT_RDWR);
     if (prev_fd_ >= 0) ::shutdown(prev_fd_, SHUT_RDWR);
+    // duplex() checks running_ after every wait; wake any futex sleepers
+    // so they observe it (the socket shutdowns handle the poll sleepers)
+    if (shm_next_) shm_next_->wake_all();
+    if (shm_prev_) shm_prev_->wake_all();
     if (wake_pipe_[1] >= 0) {
       char one = 1;
       (void)!::write(wake_pipe_[1], &one, 1);
@@ -994,6 +1094,74 @@ class Plane {
     if (!ok) fail_all_pending(err);
   }
 
+  // One full-duplex ring step with per-direction transport: a same-host
+  // edge moves bytes through its shm ring (futex-paced SPSC), a
+  // cross-host edge through its nonblocking socket. Interleaving both
+  // directions keeps the no-deadlock property of exchange() for
+  // payloads larger than either buffer; the IO_STALL_MS no-progress
+  // bound is preserved.
+  bool duplex(const char* sbuf, size_t slen, char* rbuf, size_t rlen) {
+    bool send_shm = shm_next_ && shm_next_->mapped();
+    bool recv_shm = shm_prev_ && shm_prev_->mapped();
+    if (!send_shm && !recv_shm)
+      return exchange(slen ? next_fd_ : -1, sbuf, slen,
+                      rlen ? prev_fd_ : -1, rbuf, rlen);
+    size_t soff = 0, roff = 0;
+    int idle_ms = 0;
+    while (soff < slen || roff < rlen) {
+      bool progress = false;
+      if (soff < slen && send_shm) {
+        size_t k = shm_next_->push(sbuf + soff, slen - soff);
+        if (k) { soff += k; progress = true; }
+      }
+      if (roff < rlen && recv_shm) {
+        size_t k = shm_prev_->pop(rbuf + roff, rlen - roff);
+        if (k) { roff += k; progress = true; }
+      }
+      if (soff < slen && !send_shm) {
+        ssize_t w = ::send(next_fd_, sbuf + soff, slen - soff,
+                           MSG_NOSIGNAL);
+        if (w > 0) { soff += static_cast<size_t>(w); progress = true; }
+        else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                 errno != EINTR)
+          return false;
+      }
+      if (roff < rlen && !recv_shm) {
+        ssize_t r = ::recv(prev_fd_, rbuf + roff, rlen - roff, 0);
+        if (r == 0) return false;
+        if (r > 0) { roff += static_cast<size_t>(r); progress = true; }
+        else if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                 errno != EINTR)
+          return false;
+      }
+      if (!running_) return false;
+      if (progress) { idle_ms = 0; continue; }
+      bool tcp_send = soff < slen && !send_shm;
+      bool tcp_recv = roff < rlen && !recv_shm;
+      if (tcp_send || tcp_recv) {
+        struct pollfd pf[2];
+        int n = 0;
+        if (tcp_send) pf[n++] = {next_fd_, POLLOUT, 0};
+        if (tcp_recv) pf[n++] = {prev_fd_, POLLIN, 0};
+        // a pending shm leg keeps the poll short so it stays live
+        bool shm_pending = (soff < slen && send_shm) ||
+                           (roff < rlen && recv_shm);
+        int ms = shm_pending ? 1 : 1000;
+        int pr = ::poll(pf, n, ms);
+        if (pr < 0 && errno != EINTR) return false;
+        idle_ms += (pr == 0) ? ms : 0;
+      } else if (soff < slen) {
+        shm_next_->wait_writable(5);
+        idle_ms += 5;  // upper bound; any progress resets it
+      } else {
+        shm_prev_->wait_readable(5);
+        idle_ms += 5;
+      }
+      if (idle_ms >= IO_STALL_MS) return false;
+    }
+    return true;
+  }
+
   bool ring_allreduce(Entry* e, std::string* err) {
     const int P = size_;
     size_t esz = elem_size(e->dtype);
@@ -1012,8 +1180,7 @@ class Plane {
       int r = (rank_ - step - 1 + P) % P;
       size_t slen = (seg_off[s + 1] - seg_off[s]) * esz;
       size_t rlen = (seg_off[r + 1] - seg_off[r]) * esz;
-      if (!exchange(next_fd_, buf + seg_off[s] * esz, slen, prev_fd_,
-                    scratch.data(), rlen)) {
+      if (!duplex(buf + seg_off[s] * esz, slen, scratch.data(), rlen)) {
         *err = "ring exchange failed (reduce-scatter)";
         return false;
       }
@@ -1026,8 +1193,8 @@ class Plane {
       int r = (rank_ - step + P) % P;
       size_t slen = (seg_off[s + 1] - seg_off[s]) * esz;
       size_t rlen = (seg_off[r + 1] - seg_off[r]) * esz;
-      if (!exchange(next_fd_, buf + seg_off[s] * esz, slen, prev_fd_,
-                    buf + seg_off[r] * esz, rlen)) {
+      if (!duplex(buf + seg_off[s] * esz, slen,
+                  buf + seg_off[r] * esz, rlen)) {
         *err = "ring exchange failed (allgather)";
         return false;
       }
@@ -1056,8 +1223,8 @@ class Plane {
     for (int step = 0; step < P - 1; ++step) {
       int s = (rank_ - step + P) % P;
       int r = (rank_ - step - 1 + P) % P;
-      if (!exchange(next_fd_, buf + off[s], off[s + 1] - off[s], prev_fd_,
-                    buf + off[r], off[r + 1] - off[r])) {
+      if (!duplex(buf + off[s], off[s + 1] - off[s],
+                  buf + off[r], off[r + 1] - off[r])) {
         *err = "ring exchange failed (allgatherv)";
         return false;
       }
@@ -1070,18 +1237,16 @@ class Plane {
     const int P = size_;
     int next = (rank_ + 1) % P;
     if (rank_ == root) {
-      if (next != root &&
-          !exchange(next_fd_, e->data, e->nbytes, -1, nullptr, 0)) {
+      if (next != root && !duplex(e->data, e->nbytes, nullptr, 0)) {
         *err = "broadcast send failed";
         return false;
       }
     } else {
-      if (!exchange(-1, nullptr, 0, prev_fd_, e->data, e->nbytes)) {
+      if (!duplex(nullptr, 0, e->data, e->nbytes)) {
         *err = "broadcast recv failed";
         return false;
       }
-      if (next != root &&
-          !exchange(next_fd_, e->data, e->nbytes, -1, nullptr, 0)) {
+      if (next != root && !duplex(e->data, e->nbytes, nullptr, 0)) {
         *err = "broadcast forward failed";
         return false;
       }
@@ -1115,6 +1280,10 @@ class Plane {
   std::vector<int> ctrl_fds_;        // rank 0 -> workers (index = rank)
   std::mutex ctrl_send_mu_;
   int next_fd_ = -1, prev_fd_ = -1;  // the ring
+  // same-host ring edges ride shared memory instead of the loopback
+  // socket (MPI_Win_allocate_shared staging parity,
+  // mpi_operations.cc:226-231); null = that edge stays TCP
+  std::unique_ptr<hvdshm::Channel> shm_next_, shm_prev_;
 
   std::mutex api_mu_;
   std::mutex enqueue_order_mu_;  // serializes {table insert, READY send}

@@ -1,0 +1,213 @@
+// Same-host shared-memory transport for the native plane's ring edges.
+//
+// Reference parity: MPIAllreduce stages node-local traffic through an MPI
+// shared-memory window (MPI_Win_allocate_shared,
+// /root/reference/horovod/common/ops/mpi_operations.cc:226-231) so
+// same-host bytes never ride the loopback socket. Here each DIRECTED ring
+// edge (rank -> next) between two processes on one host gets one SPSC
+// byte ring in POSIX shared memory; cross-host edges and the whole
+// control plane stay TCP.
+//
+// Synchronization is a futex per counter (FUTEX_WAIT/WAKE on the 32-bit
+// head/tail sequence words): the producer sleeps only when the ring is
+// full, the consumer only when it is empty, and every push/pop wakes the
+// other side. Counters are free-running uint32 byte sequences (capacity
+// divides 2^32, so wraparound arithmetic is exact).
+#pragma once
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace hvdshm {
+
+static const size_t RING_CAP = size_t(1) << 22;  // 4 MB per edge
+
+struct Region {
+  std::atomic<uint32_t> head;  // producer byte sequence
+  char pad1[60];
+  std::atomic<uint32_t> tail;  // consumer byte sequence
+  char pad2[60];
+  char data[RING_CAP];
+};
+
+// SHARED futex ops, not *_PRIVATE: the waiter and the waker are
+// different processes mapping the same physical page, and private
+// futexes hash by per-process virtual address — a private wake would
+// never reach the peer, turning every blocked wait into a full timeout.
+inline int futex_wait_ms(std::atomic<uint32_t>* addr, uint32_t expect,
+                         int timeout_ms) {
+  struct timespec ts = {timeout_ms / 1000, (timeout_ms % 1000) * 1000000L};
+  return static_cast<int>(::syscall(
+      SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT,
+      expect, &ts, nullptr, 0));
+}
+
+inline void futex_wake(std::atomic<uint32_t>* addr) {
+  ::syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr),
+            FUTEX_WAKE, INT32_MAX, nullptr, nullptr, 0);
+}
+
+// One directed SPSC edge. The producer (ring rank) creates the object;
+// the consumer (its successor) opens it and unlinks the name once
+// mapped, so nothing outlives the job even on a crash.
+class Channel {
+ public:
+  bool create(const std::string& name) {
+    name_ = name;
+    ::shm_unlink(name.c_str());  // stale object from a dead job
+    int fd = ::shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return false;
+    if (::ftruncate(fd, sizeof(Region)) != 0) {
+      ::close(fd);
+      ::shm_unlink(name.c_str());
+      return false;
+    }
+    bool ok = map_fd(fd);
+    ::close(fd);
+    if (ok) {
+      region_->head.store(0, std::memory_order_relaxed);
+      region_->tail.store(0, std::memory_order_relaxed);
+      created_ = true;
+    } else {
+      ::shm_unlink(name.c_str());
+    }
+    return ok;
+  }
+
+  bool open_with_deadline(const std::string& name, double timeout_s) {
+    name_ = name;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(
+                        static_cast<int64_t>(timeout_s * 1000));
+    int fd = -1;
+    while (fd < 0) {
+      fd = ::shm_open(name.c_str(), O_RDWR, 0600);
+      if (fd >= 0) break;
+      if (std::chrono::steady_clock::now() > deadline) return false;
+      ::usleep(2000);  // producer not there yet
+    }
+    // the producer ftruncates right after create: wait for full size
+    struct stat st;
+    while (::fstat(fd, &st) == 0 &&
+           st.st_size < static_cast<off_t>(sizeof(Region))) {
+      if (std::chrono::steady_clock::now() > deadline) {
+        ::close(fd);
+        return false;
+      }
+      ::usleep(2000);
+    }
+    bool ok = map_fd(fd);
+    ::close(fd);
+    if (ok) ::shm_unlink(name.c_str());  // both ends mapped; drop the name
+    return ok;
+  }
+
+  // copy up to len bytes in; returns bytes copied (0 = ring full)
+  size_t push(const char* buf, size_t len) {
+    uint32_t head = region_->head.load(std::memory_order_relaxed);
+    uint32_t tail = region_->tail.load(std::memory_order_acquire);
+    size_t avail = static_cast<uint32_t>(head - tail);
+    size_t space = RING_CAP - avail;
+    size_t n = len < space ? len : space;
+    if (n == 0) return 0;
+    size_t pos = head % RING_CAP;
+    size_t first = RING_CAP - pos < n ? RING_CAP - pos : n;
+    std::memcpy(region_->data + pos, buf, first);
+    std::memcpy(region_->data, buf + first, n - first);
+    region_->head.store(head + static_cast<uint32_t>(n),
+                        std::memory_order_release);
+    // wake only on the empty->nonempty transition: the consumer can
+    // only be in (or entering) futex_wait when it observed empty, and
+    // its wait's expect-value re-check makes the skipped wake safe —
+    // if it saw our new head it will not sleep; if it saw the old one
+    // the kernel rejects the wait (EAGAIN). Saves a syscall per chunk
+    // on the hot path.
+    if (avail == 0) futex_wake(&region_->head);
+    return n;
+  }
+
+  // copy up to len bytes out; returns bytes copied (0 = ring empty)
+  size_t pop(char* buf, size_t len) {
+    uint32_t tail = region_->tail.load(std::memory_order_relaxed);
+    uint32_t head = region_->head.load(std::memory_order_acquire);
+    size_t avail = static_cast<uint32_t>(head - tail);
+    size_t n = len < avail ? len : avail;
+    if (n == 0) return 0;
+    size_t pos = tail % RING_CAP;
+    size_t first = RING_CAP - pos < n ? RING_CAP - pos : n;
+    std::memcpy(buf, region_->data + pos, first);
+    std::memcpy(buf + first, region_->data, n - first);
+    region_->tail.store(tail + static_cast<uint32_t>(n),
+                        std::memory_order_release);
+    // mirror of push: the producer only sleeps when it observed full
+    if (avail == RING_CAP) futex_wake(&region_->tail);
+    return n;
+  }
+
+  // block (bounded) until the consumer advances past the full state seen
+  // at call time; ms caps the sleep
+  void wait_writable(int ms) {
+    uint32_t tail = region_->tail.load(std::memory_order_acquire);
+    uint32_t head = region_->head.load(std::memory_order_relaxed);
+    if (RING_CAP - static_cast<uint32_t>(head - tail) > 0) return;
+    futex_wait_ms(&region_->tail, tail, ms);
+  }
+
+  // block (bounded) until the producer advances past the empty state
+  void wait_readable(int ms) {
+    uint32_t head = region_->head.load(std::memory_order_acquire);
+    uint32_t tail = region_->tail.load(std::memory_order_relaxed);
+    if (static_cast<uint32_t>(head - tail) > 0) return;
+    futex_wait_ms(&region_->head, head, ms);
+  }
+
+  bool mapped() const { return region_ != nullptr; }
+
+  // rouse any thread parked in a futex wait (shutdown path) without
+  // tearing down the mapping other threads may still be touching
+  void wake_all() {
+    if (region_ != nullptr) {
+      futex_wake(&region_->head);
+      futex_wake(&region_->tail);
+    }
+  }
+
+  void close_channel() {
+    if (region_ != nullptr) {
+      // wake any peer blocked in a futex so shutdown never hangs it
+      futex_wake(&region_->head);
+      futex_wake(&region_->tail);
+      ::munmap(region_, sizeof(Region));
+      region_ = nullptr;
+    }
+    if (created_) ::shm_unlink(name_.c_str());  // no-op if consumer did
+  }
+
+  ~Channel() { close_channel(); }
+
+ private:
+  bool map_fd(int fd) {
+    void* p = ::mmap(nullptr, sizeof(Region), PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    if (p == MAP_FAILED) return false;
+    region_ = static_cast<Region*>(p);
+    return true;
+  }
+
+  Region* region_ = nullptr;
+  bool created_ = false;
+  std::string name_;
+};
+
+}  // namespace hvdshm
