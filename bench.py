@@ -315,6 +315,12 @@ def main():
         r_window_means.append(float(np.mean(block)))
         if tlm_window is not None:
             try:
+                # untimed executable-switch warmup: the first window
+                # after the resident program changes pays
+                # reload/cache-churn costs (measured +-36 ms spread
+                # without it; ResNet's per-block warmup iter plays the
+                # same role on its side)
+                tlm_window()
                 t_window_s.append(tlm_window())
             except Exception as e:  # noqa: BLE001
                 print(f"transformer window failed: {e}", file=sys.stderr)
