@@ -106,8 +106,19 @@ class Autotuner:
         self.threshold = float(config.fusion_threshold)
         self.cycle_time_ms = float(config.cycle_time_ms)
         self.frozen = False
-        self._engine = (_NativeEngine(seed) if _native.available()
-                        else _PythonEngine(seed))
+        if _native.available():
+            self._engine = _NativeEngine(seed)
+        else:
+            # say so out loud: the fallback explores by random search,
+            # not GP+EI — users who built without the native core should
+            # know their tuning quality silently differs
+            from ..common import hvd_logging as log
+            log.warning(
+                "HOROVOD_AUTOTUNE is on but the native core "
+                "(libhvd_core.so) is not built: falling back to "
+                "random-search exploration instead of Bayesian GP+EI. "
+                "Build it with `python setup.py build_native`.")
+            self._engine = _PythonEngine(seed)
         self._cycle_bytes = 0
         self._cycle_time = 0.0
         self._cycles = 0
