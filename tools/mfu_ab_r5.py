@@ -49,16 +49,19 @@ def build(batch, seq=1024, inner=10, cfg=None, vocab_chunk=0):
     step, params, opt_state, toks, cfg = build_transformer_step(
         mesh, batch, seq, cfg=cfg, on_tpu=True, n_steps=inner,
         vocab_chunk=vocab_chunk)
-    live = {"p": params, "o": opt_state}
+    live = {"p": params, "o": opt_state, "t": toks}
 
     def window():
         t0 = time.perf_counter()
-        live["p"], live["o"], loss = step(live["p"], live["o"], toks)
+        live["p"], live["o"], loss = step(live["p"], live["o"], live["t"])
         float(loss)
         return (time.perf_counter() - t0) / inner
 
+    def release():
+        live.clear()
+
     window()  # compile + warmup
-    return window, cfg
+    return window, cfg, release
 
 
 class BlockPatch:
@@ -117,11 +120,17 @@ def main():
     ap.add_argument("--vocab-chunk", type=int, default=0)
     ap.add_argument("--rounds", type=int, default=3,
                     help="paired (base, variant) window rounds")
+    ap.add_argument("--sequential", action="store_true",
+                    help="bracketed sequential mode (teardown between "
+                         "builds; required at llama-1b scale)")
     args = ap.parse_args()
 
-    base_window, cfg = build(args.batch, args.seq, args.inner,
-                             cfg=make_cfg(args.size),
-                             vocab_chunk=args.vocab_chunk)
+    if args.sequential:
+        return run_sequential(args)
+
+    base_window, cfg, _ = build(args.batch, args.seq, args.inner,
+                                cfg=make_cfg(args.size),
+                                vocab_chunk=args.vocab_chunk)
     from bench_common import transformer_matmul_flops_per_token
     flops_tok = transformer_matmul_flops_per_token(cfg, args.seq)
 
@@ -130,9 +139,9 @@ def main():
         label, kw, patch = parse_variant(spec.strip(), args)
         if patch is not None:
             with patch:
-                v_window, _ = build(**kw)
+                v_window, _, v_release = build(**kw)
         else:
-            v_window, _ = build(**kw)
+            v_window, _, v_release = build(**kw)
         vbatch = kw["batch"]
         base_s, var_s = [], []
         for rd in range(args.rounds):
@@ -141,6 +150,7 @@ def main():
                 order = order[::-1]
             for win, sink in order:
                 sink.append(win())
+        v_release()
         b = float(np.median(base_s))
         v = float(np.median(var_s))
         base_tok = args.batch * args.seq / b
@@ -156,6 +166,65 @@ def main():
         }
         print(json.dumps({label: results[label]}), flush=True)
     print(json.dumps({"summary": results}))
+
+
+def run_sequential(args):
+    """Bracketed sequential mode for models too big for base+variant
+    co-residency (llama-1b: params+optimizer ~12 GB each): measure
+    base, then each variant, then base AGAIN, all with teardown between
+    builds. The bracketing bases bound session drift — if they
+    disagree, the run says so instead of publishing a knob effect."""
+    from bench_common import transformer_matmul_flops_per_token
+
+    def measure(spec_label, kw, patch):
+        import jax
+        try:
+            if patch is not None:
+                with patch:
+                    window, cfg, release = build(**kw)
+            else:
+                window, cfg, release = build(**kw)
+        except Exception as e:  # noqa: BLE001 — OOM is a RESULT here
+            msg = str(e)
+            if "memory" in msg.lower() or "RESOURCE_EXHAUSTED" in msg:
+                jax.clear_caches()
+                return None, None, kw["batch"]
+            raise
+        s = [window() for _ in range(args.rounds)]
+        release()
+        return float(np.median(s)), cfg, kw["batch"]
+
+    base_kw = {"batch": args.batch, "seq": args.seq, "inner": args.inner,
+               "cfg": make_cfg(args.size), "vocab_chunk": args.vocab_chunk}
+    base1, cfg, _ = measure("base", dict(base_kw), None)
+    flops_tok = transformer_matmul_flops_per_token(cfg, args.seq)
+    variants = []
+    for spec in args.variants.split(","):
+        label, kw, patch = parse_variant(spec.strip(), args)
+        v, _, vbatch = measure(label, kw, patch)
+        variants.append((label, v, vbatch))
+        print(json.dumps({label: "oom" if v is None
+                          else round(v * 1e3, 2)}), flush=True)
+    base2, _, _ = measure("base", dict(base_kw), None)
+    base = (base1 + base2) / 2
+    drift_pct = abs(base2 - base1) / base * 100
+    out = {"base_ms": round(base * 1e3, 2),
+           "base_bracket_drift_pct": round(drift_pct, 2),
+           "base_mfu": round(
+               args.batch * args.seq / base * flops_tok / 197e12, 4)}
+    for label, v, vbatch in variants:
+        if v is None:
+            out[label] = {"oom": True}
+            continue
+        tok = vbatch * args.seq / v
+        out[label] = {
+            "ms": round(v * 1e3, 2),
+            "tok_s": round(tok),
+            "mfu": round(tok * flops_tok / 197e12, 4),
+            "vs_base": round((args.batch * args.seq / base) and
+                             tok / (args.batch * args.seq / base), 4),
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
