@@ -553,9 +553,12 @@ class NegotiationWorker:
         last = None
         while True:
             try:
+                # retry_requests: CycleRequests are idempotent at the
+                # coordinator (req_id dedupe), so the transport may
+                # silently resend over a fresh socket
                 self._client = network.BasicClient(
                     SERVICE_NAME, addr_map, key, probe_timeout=2.0,
-                    attempts=1)
+                    attempts=1, retry_requests=True)
                 break
             except network.NoValidAddressesFound as e:
                 last = e
@@ -575,6 +578,10 @@ class NegotiationWorker:
         mid-cycle still receive their shutdown=True responses instead of
         connection errors (the reference's shutdown Response reaches every
         rank before MPI_Finalize, operations.cc:1101-1122)."""
+        try:
+            self._client.close()  # release the persistent socket
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
         if self.service is not None:
             service, self.service = self.service, None
             timer = threading.Timer(linger_s, service.shutdown)

@@ -63,8 +63,18 @@ class Wire:
 
     def read(self, rfile):
         digest = rfile.read(secret.DIGEST_LENGTH)
-        (length,) = struct.unpack("i", rfile.read(4))
+        if len(digest) < secret.DIGEST_LENGTH:
+            raise EOFError("peer closed the connection")
+        raw_len = rfile.read(4)
+        if len(raw_len) < 4:
+            raise EOFError("peer closed the connection mid-header")
+        (length,) = struct.unpack("i", raw_len)
         body = rfile.read(length)
+        if len(body) < length:
+            # a disconnect mid-body must read as a disconnect — falling
+            # through would fail the HMAC check and misdiagnose it as
+            # an auth failure
+            raise EOFError("peer closed the connection mid-message")
         with self._count_lock:
             self.bytes_in += secret.DIGEST_LENGTH + 4 + length
         if not secret.check_digest(self._key, body, digest):
@@ -123,6 +133,12 @@ class BasicService:
     def __init__(self, service_name, key):
         self._service_name = service_name
         self._wire = Wire(key)
+        # live persistent connections: shutdown() must sever them, or
+        # clients looping on an established socket would keep being
+        # served by daemon handler threads after the accept loop stops
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        self._closing = False
         self._server = self._bind_ephemeral()
         self._port = self._server.socket.getsockname()[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -150,14 +166,42 @@ class BasicService:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                # serve MANY requests per connection: high-cadence
+                # clients (the negotiation cycle at 5 ms) keep one
+                # persistent socket instead of a TCP handshake per
+                # request. One-shot clients just close after their
+                # response; the read then raises EOFError and the
+                # connection winds down.
+                with service._conns_lock:
+                    service._conns.add(self.connection)
+                # re-check AFTER registering: a shutdown() racing this
+                # accept either saw the socket in _conns (and severed
+                # it) or set _closing first (and we bail here) — either
+                # way no handler outlives the service
+                if service._closing:
+                    return
+                # no Nagle on the response stream: with per-request
+                # connections the close flushed each small response;
+                # on a persistent socket Nagle + delayed ACK would park
+                # them for up to 40 ms
                 try:
-                    req = service._wire.read(self.rfile)
-                    resp = service._handle(req, self.client_address)
-                    if resp is None:
-                        raise RuntimeError("Handler returned no response.")
-                    service._wire.write(resp, self.wfile)
-                except (EOFError, ConnectionError):
+                    self.connection.setsockopt(socket.IPPROTO_TCP,
+                                               socket.TCP_NODELAY, 1)
+                except OSError:
                     pass
+                try:
+                    while True:
+                        req = service._wire.read(self.rfile)
+                        resp = service._handle(req, self.client_address)
+                        if resp is None:
+                            raise RuntimeError(
+                                "Handler returned no response.")
+                        service._wire.write(resp, self.wfile)
+                except (EOFError, ConnectionError, struct.error):
+                    pass
+                finally:
+                    with service._conns_lock:
+                        service._conns.discard(self.connection)
 
         return Handler
 
@@ -175,8 +219,16 @@ class BasicService:
         return self._port
 
     def shutdown(self):
+        self._closing = True  # before severing: see the handler re-check
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class BasicClient:
@@ -188,11 +240,19 @@ class BasicClient:
     """
 
     def __init__(self, service_name, addresses, key, probe_timeout=5.0,
-                 attempts=3):
+                 attempts=3, retry_requests=False):
         self._service_name = service_name
         self._wire = Wire(key)
         self._timeout = probe_timeout
         self._addr = None
+        self._sock = self._rfile = self._wfile = None
+        self._req_lock = threading.Lock()  # one in-flight request/conn
+        # transport-level resend on a dead persistent socket. Only safe
+        # when the SERVICE deduplicates (the negotiation coordinator's
+        # req_id); a non-idempotent RPC (launch services running
+        # commands) must see the failure instead — its caller owns the
+        # retry policy.
+        self._retry_requests = retry_requests
         for _ in range(attempts):
             self._addr = self._probe(addresses)
             if self._addr:
@@ -233,8 +293,54 @@ class BasicClient:
             self._wire.write(req, wfile)
             return self._wire.read(rfile)
 
+    def _connect_persistent(self):
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._wfile = sock.makefile("wb")
+
+    def _close_persistent(self):
+        for attr in ("_rfile", "_wfile", "_sock"):
+            obj = getattr(self, attr, None)
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+            setattr(self, attr, None)
+
     def request(self, req):
-        return self._request_at(req, self._addr)
+        """One request/response over a PERSISTENT connection (the
+        server's handler loops per connection): high-cadence callers —
+        the 5 ms negotiation cycle — skip a TCP handshake per request.
+        A dead socket closes and, when ``retry_requests`` (dedup-safe
+        services only), gets one silent reconnect-and-resend; otherwise
+        the error propagates and the NEXT request reconnects."""
+        with self._req_lock:
+            last = 1 if self._retry_requests else 0
+            for attempt in range(last + 1):
+                try:
+                    if self._sock is None:
+                        self._connect_persistent()
+                    self._wire.write(req, self._wfile)
+                    return self._wire.read(self._rfile)
+                except (OSError, EOFError, struct.error):
+                    self._close_persistent()
+                    if attempt == last:
+                        raise
+                except BaseException:
+                    # unexpected failure (e.g. a genuine HMAC mismatch):
+                    # the stream position is undefined — never reuse it
+                    self._close_persistent()
+                    raise
+
+    def close(self):
+        """Release the persistent connection (and its server-side
+        handler thread) deterministically."""
+        with self._req_lock:
+            self._close_persistent()
 
     @property
     def address(self):
