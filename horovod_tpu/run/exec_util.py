@@ -93,6 +93,11 @@ def terminate_trees(procs, grace_s=1.5):
                 os.killpg(os.getpgid(p.pid), signal.SIGKILL)
             except Exception:  # noqa: BLE001 — lost the race, fine
                 pass
+    for p in live:  # reap: SIGKILL is asynchronous; don't leave zombies
+        try:
+            p.wait(timeout=2.0)
+        except Exception:  # noqa: BLE001 — truly wedged; move on
+            pass
 
 
 def terminate_tree(proc, grace_s=5.0):

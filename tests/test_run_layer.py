@@ -216,3 +216,61 @@ class TestLocalLaunch:
             capture_output=True, text=True, timeout=120,
             cwd="/root/repo")
         assert res.returncode == 0, res.stderr
+
+    def test_cli_exports_secret_to_workers(self, monkeypatch):
+        """The per-job secret must reach every worker's env: the
+        negotiated eager control plane derives its HMAC key from it
+        (ops/negotiation.py control_key). Regression pin for the
+        round-5 fix — without it, hvdrun jobs silently fell back to
+        the strict same-order contract."""
+        import signal
+
+        import pytest as _pytest
+
+        from horovod_tpu.run import cli, secret
+
+        captured = {}
+
+        def fake_run(host_list, command, coordinator_addr, settings,
+                     output_dir=None, extra_env=None, cancel_event=None):
+            captured["extra_env"] = extra_env
+            return 0
+
+        monkeypatch.setattr(cli, "run_command_on_hosts", fake_run)
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            with _pytest.raises(SystemExit) as e:
+                cli.main(["-np", "1", "true"])
+        finally:
+            signal.signal(signal.SIGTERM, prev)  # main() installs one
+        assert e.value.code == 0
+        assert captured["extra_env"] is not None
+        assert secret.HVD_SECRET_KEY in captured["extra_env"]
+
+    def test_terminate_trees_kills_sigterm_ignoring_group(self, tmp_path):
+        """terminate_trees must reach its SIGKILL pass promptly even
+        when the process ignores SIGTERM (jax's preemption notifier
+        swallows it) — the leak mode behind the round-5 elastic-drill
+        fix."""
+        import time as _time
+
+        from horovod_tpu.run import exec_util
+
+        script = tmp_path / "stubborn.py"
+        script.write_text(
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(60)\n")
+        procs = [exec_util.safe_execute(
+            [sys.executable, str(script)], stdout=subprocess.PIPE)
+            for _ in range(2)]
+        for p in procs:
+            assert p.stdout.readline().strip() == b"ready"
+        t0 = _time.monotonic()
+        exec_util.terminate_trees(procs, grace_s=0.5)
+        dt = _time.monotonic() - t0
+        for p in procs:
+            assert p.poll() is not None, "stubborn worker survived"
+        # one SHARED grace window, not one per proc
+        assert dt < 5.0, dt
