@@ -21,7 +21,7 @@ from .version import __version__  # noqa: F401
 
 from .common.exceptions import (  # noqa: F401
     DuplicateNameError, HorovodError, MismatchError, NotInitializedError,
-    ShutdownError, StalledError)
+    RanksLostError, ShutdownError, StalledError)
 from .common.config import HorovodConfig  # noqa: F401
 from .mpi_ops import (  # noqa: F401
     init, shutdown, is_initialized, mpi_threads_supported,

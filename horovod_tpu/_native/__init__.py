@@ -108,7 +108,10 @@ def build_plane(force=False):
     the ctypes surface for the torch frontend)."""
     src_dir = os.path.join(_DIR, "src")
     sources = [os.path.join(src_dir, "plane_c.cc")]
-    deps = sources + [os.path.join(src_dir, "plane.h")]
+    # shm_ring.h is included by plane.h: leaving it out of the dep list
+    # made edits to the shm transport silently not rebuild
+    deps = sources + [os.path.join(src_dir, "plane.h"),
+                      os.path.join(src_dir, "shm_ring.h")]
     if not force and os.path.exists(_PLANE_LIB_PATH):
         if os.path.getmtime(_PLANE_LIB_PATH) >= max(
                 os.path.getmtime(d) for d in deps):
@@ -132,7 +135,8 @@ def build_tf(force=False):
     import tensorflow as tf  # deferred: TF is an optional frontend dep
 
     src = os.path.join(_DIR, "src", "tf_ops.cc")
-    deps = [src, os.path.join(_DIR, "src", "plane.h")]
+    deps = [src, os.path.join(_DIR, "src", "plane.h"),
+            os.path.join(_DIR, "src", "shm_ring.h")]
     if not force and os.path.exists(_TF_LIB_PATH):
         if os.path.getmtime(_TF_LIB_PATH) >= max(
                 os.path.getmtime(d) for d in deps):

@@ -305,11 +305,23 @@ static bool exchange(int send_fd, const char* sbuf, size_t slen,
 }
 
 // Machine identity for same-host detection: kernel boot id + IPC
-// namespace. Source-IP comparison would false-positive behind NAT
-// (distinct hosts, one apparent address) and false-negative on
-// multi-homed hosts; and two containers on one kernel share a boot id
-// but NOT /dev/shm, so the IPC namespace must match too. Hostname is
-// the fallback when /proc is unavailable.
+// namespace + the identity of the /dev/shm mount itself. Source-IP
+// comparison would false-positive behind NAT (distinct hosts, one
+// apparent address) and false-negative on multi-homed hosts; two
+// containers on one kernel share a boot id but NOT /dev/shm, so
+// namespace identity must match too. The IPC namespace alone is NOT
+// sufficient: POSIX shm objects live on the tmpfs mounted at /dev/shm,
+// which belongs to the MOUNT namespace — two containers can share an
+// IPC namespace (e.g. k8s pods with hostIPC, or docker --ipc=container:)
+// while each mounts a PRIVATE /dev/shm. Matching on ipc-ns alone made
+// such peers negotiate shm rings whose names never meet, burning the
+// full open_with_deadline window at init before falling back. The
+// st_dev+st_ino of /dev/shm identifies the tmpfs instance: same mount
+// => shm_open meets, different mounts => ids differ and the edge stays
+// TCP from the start. If /dev/shm cannot be stat'ed at all, the id is
+// salted per-process so shm is never negotiated (no shared tmpfs means
+// no transport anyway). HVD_PLANE_SHM=0 remains the manual escape.
+// Hostname is the fallback when /proc is unavailable.
 static std::string machine_id() {
   std::string id;
   FILE* f = ::fopen("/proc/sys/kernel/random/boot_id", "r");
@@ -328,6 +340,17 @@ static std::string machine_id() {
   char ns[64] = {0};
   ssize_t n = ::readlink("/proc/self/ns/ipc", ns, sizeof(ns) - 1);
   if (n > 0) id += "." + std::string(ns, static_cast<size_t>(n));
+  struct stat st;
+  char shmid[64];
+  if (::stat("/dev/shm", &st) == 0) {
+    std::snprintf(shmid, sizeof(shmid), ".shm:%llx:%llx",
+                  static_cast<unsigned long long>(st.st_dev),
+                  static_cast<unsigned long long>(st.st_ino));
+  } else {
+    std::snprintf(shmid, sizeof(shmid), ".noshm:%d",
+                  static_cast<int>(::getpid()));
+  }
+  id += shmid;
   return id;
 }
 

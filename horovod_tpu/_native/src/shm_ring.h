@@ -10,9 +10,32 @@
 //
 // Synchronization is a futex per counter (FUTEX_WAIT/WAKE on the 32-bit
 // head/tail sequence words): the producer sleeps only when the ring is
-// full, the consumer only when it is empty, and every push/pop wakes the
-// other side. Counters are free-running uint32 byte sequences (capacity
-// divides 2^32, so wraparound arithmetic is exact).
+// full, the consumer only when it is empty. Counters are free-running
+// uint32 byte sequences (capacity divides 2^32, so wraparound arithmetic
+// is exact).
+//
+// BOUNDED-WAIT INVARIANT (the contract Channel's callers rely on): a
+// peer parked in wait_readable/wait_writable is released within ONE
+// counter transition by the other side, never one futex timeout. Two
+// mechanisms uphold it, and both are load-bearing:
+//
+//   waiter side — the expect-value handed to FUTEX_WAIT is re-checked
+//   by the kernel under the futex bucket lock, so a counter advance
+//   that lands before the park turns the wait into EAGAIN (no sleep on
+//   stale state);
+//
+//   waker side — push()/pop() re-load the COUNTERPART counter after a
+//   seq_cst fence that follows their own counter store (Dekker-style
+//   store→fence→load pairing), and wake whenever the re-loaded value
+//   shows the peer could have observed the pre-store state (empty for
+//   the consumer, full for the producer). Deciding the wake from a
+//   value loaded BEFORE the data copy — as an earlier revision did —
+//   loses the wake when the peer drains/fills the ring during the
+//   copy and parks against the old counter: neither the kernel check
+//   (it parked before the store became visible to it) nor the skipped
+//   wake releases it, and it eats the full timeout. The futex timeout
+//   is therefore a crash-tolerance backstop (peer died mid-protocol),
+//   not part of the happy path.
 #pragma once
 
 #include <fcntl.h>
@@ -127,13 +150,19 @@ class Channel {
     std::memcpy(region_->data, buf + first, n - first);
     region_->head.store(head + static_cast<uint32_t>(n),
                         std::memory_order_release);
-    // wake only on the empty->nonempty transition: the consumer can
-    // only be in (or entering) futex_wait when it observed empty, and
-    // its wait's expect-value re-check makes the skipped wake safe —
-    // if it saw our new head it will not sleep; if it saw the old one
-    // the kernel rejects the wait (EAGAIN). Saves a syscall per chunk
-    // on the hot path.
-    if (avail == 0) futex_wake(&region_->head);
+    // wake only on the empty->nonempty transition — but decide it from
+    // the tail RE-LOADED after a seq_cst fence, not from the pre-copy
+    // `avail`: the consumer may drain the ring during our memcpy and
+    // park against the old head, in which case the stale read says
+    // "ring was non-empty, skip the wake" and the consumer eats a full
+    // futex timeout (the lost-wake race; see the bounded-wait invariant
+    // above). After the fence, either we observe its final tail
+    // (== old head -> wake) or it observes our new head (kernel
+    // expect-check refuses the park). Still saves the syscall on the
+    // hot path where the consumer is demonstrably behind.
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    uint32_t tail2 = region_->tail.load(std::memory_order_relaxed);
+    if (tail2 == head) futex_wake(&region_->head);
     return n;
   }
 
@@ -150,13 +179,22 @@ class Channel {
     std::memcpy(buf + first, region_->data, n - first);
     region_->tail.store(tail + static_cast<uint32_t>(n),
                         std::memory_order_release);
-    // mirror of push: the producer only sleeps when it observed full
-    if (avail == RING_CAP) futex_wake(&region_->tail);
+    // mirror of push: the producer only sleeps when it observed FULL
+    // relative to our pre-pop tail — re-load its head after the fence
+    // so a producer that topped the ring up during our memcpy (and is
+    // parking against that tail) is never left to its timeout
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    uint32_t head2 = region_->head.load(std::memory_order_relaxed);
+    if (static_cast<uint32_t>(head2 - tail) == RING_CAP)
+      futex_wake(&region_->tail);
     return n;
   }
 
   // block (bounded) until the consumer advances past the full state seen
-  // at call time; ms caps the sleep
+  // at call time; ms caps the sleep. Safe against stale loads without a
+  // fence of its own: the tail value doubles as FUTEX_WAIT's expect, and
+  // the kernel re-checks it under the bucket lock (bounded-wait
+  // invariant, waiter side).
   void wait_writable(int ms) {
     uint32_t tail = region_->tail.load(std::memory_order_acquire);
     uint32_t head = region_->head.load(std::memory_order_relaxed);
@@ -164,7 +202,8 @@ class Channel {
     futex_wait_ms(&region_->tail, tail, ms);
   }
 
-  // block (bounded) until the producer advances past the empty state
+  // block (bounded) until the producer advances past the empty state;
+  // the head expect-value is kernel-re-checked exactly as above
   void wait_readable(int ms) {
     uint32_t head = region_->head.load(std::memory_order_acquire);
     uint32_t tail = region_->tail.load(std::memory_order_relaxed);

@@ -72,6 +72,23 @@ class HorovodConfig:
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0  # 0 = never hard-shutdown
+    # Liveness: the coordinator declares a rank LOST (fail-fast
+    # RanksLostError to every surviving rank) when it has heartbeated at
+    # least once and then gone silent for this long. 0 disables the
+    # escalation — the legacy warn-only behavior.
+    rank_lost_timeout_seconds: float = 0.0
+    # Worker-side mirror: how long the coordinator must stay unreachable
+    # before a worker fails its pending work. 0 = the engine's built-in
+    # default (EagerCoordinator.POISON_GRACE_S).
+    coordinator_lost_timeout_seconds: float = 0.0
+    # Chaos plane (run/chaos.py): deterministic fault injection on the
+    # control-plane transport. Spec grammar:
+    #   service:message:fault:prob[:count][;more rules]
+    # e.g. "hvd.negotiation:CycleResponse:drop_response:0.2". Empty
+    # disables injection entirely (the default — production safe).
+    chaos_spec: str = ""
+    chaos_seed: int = 0
+    chaos_delay_ms: float = 50.0
     # Autotuning of fusion_threshold / cycle_time.
     autotune: bool = False
     autotune_log: str = ""
@@ -103,6 +120,13 @@ class HorovodConfig:
                 "STALL_CHECK_TIME_SECONDS", 60.0),
             stall_shutdown_time_seconds=env_float(
                 "STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            rank_lost_timeout_seconds=env_float(
+                "RANK_LOST_TIMEOUT_SECONDS", 0.0),
+            coordinator_lost_timeout_seconds=env_float(
+                "COORDINATOR_LOST_TIMEOUT_SECONDS", 0.0),
+            chaos_spec=env_str("CHAOS_SPEC", "") or "",
+            chaos_seed=env_int("CHAOS_SEED", 0),
+            chaos_delay_ms=env_float("CHAOS_DELAY_MS", 50.0),
             autotune=env_bool("AUTOTUNE", False),
             autotune_log=env_str("AUTOTUNE_LOG", "") or "",
             autotune_sync_collectives=env_int("AUTOTUNE_SYNC_COLLECTIVES",

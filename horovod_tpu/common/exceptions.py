@@ -39,6 +39,34 @@ class ShutdownError(HorovodError):
         super().__init__(msg)
 
 
+class RanksLostError(ShutdownError):
+    """The control plane declared one or more ranks dead.
+
+    Raised when the coordinator's liveness ledger sees no heartbeat
+    (negotiation cycle) from a rank for longer than
+    ``HOROVOD_RANK_LOST_TIMEOUT_SECONDS``, or when a worker declares the
+    coordinator itself unreachable past its grace window. Subclasses
+    ``ShutdownError`` so existing handlers keep working; carries the dead
+    ranks in ``.ranks`` so supervisors (run/elastic.py) can shrink around
+    them. Workers exiting on this error use ``EXIT_CODE`` so the launcher
+    propagates a machine-readable fail-fast signal.
+    """
+
+    # distinct from generic failure (1) and SIGTERM (143): the elastic
+    # supervisor keys auto-shrink on exactly this code
+    EXIT_CODE = 44
+
+    def __init__(self, ranks, reason=None):
+        self.ranks = tuple(sorted({int(r) for r in ranks}))
+        msg = (f"Horovod ranks {list(self.ranks)} are lost: no "
+               f"control-plane heartbeat within the deadline. Pending "
+               f"collectives cannot complete and have been failed.")
+        if reason:
+            msg += f" ({reason})"
+        # bypass ShutdownError.__init__'s canned message
+        super(ShutdownError, self).__init__(msg)
+
+
 class DuplicateNameError(HorovodError):
     """Two outstanding collectives share a name.
 

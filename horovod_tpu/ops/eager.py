@@ -55,7 +55,8 @@ from ..common import compat
 from ..common import hvd_logging as log
 from ..common import state as state_mod
 from ..common.exceptions import (DuplicateNameError, MismatchError,
-                                 ShutdownError, StalledError)
+                                 RanksLostError, ShutdownError,
+                                 StalledError)
 from ..utils import timeline as timeline_mod
 
 ALLREDUCE = "allreduce"
@@ -194,6 +195,11 @@ class EagerCoordinator:
         self.handles = HandleManager()
         self.plan_cache = PlanCache(self._config.cache_capacity)
         self._shutdown = False
+        # coordinator-lost deadline: config override, else the class
+        # default (tests patch the class attribute before init)
+        self._poison_grace_s = (
+            getattr(self._config, "coordinator_lost_timeout_seconds", 0.0)
+            or self.POISON_GRACE_S)
         self._paused = False  # test hook: lets stall detection be exercised
         self._stall_warned = set()
         self._verified_sigs = set()  # cross-process checks done (signature)
@@ -593,7 +599,7 @@ class EagerCoordinator:
             self._cycle_backoff_until = now + min(
                 0.05 * (2 ** min(self._cycle_failures - 1, 5)), 1.6)
             if (self._cycle_failures >= 3 and
-                    now - self._cycle_fail_since >= self.POISON_GRACE_S):
+                    now - self._cycle_fail_since >= self._poison_grace_s):
                 # The coordinator is gone (rank 0 exited/crashed), and has
                 # been for a real time window — not just a transient pause:
                 # fail pending work with a clear error instead of hanging,
@@ -601,8 +607,12 @@ class EagerCoordinator:
                 # rather than left blocked in matching collectives, and
                 # poison this coordinator — continuing to negotiate after
                 # dropping state would diverge from the peers anyway.
-                self._fail_pending_negotiated(ShutdownError(
-                    f"negotiation control plane unreachable: {exc}"))
+                # RanksLostError: the coordinator IS rank 0's process, so
+                # losing the plane is losing rank 0 — supervisors key
+                # their auto-shrink on this type's exit code.
+                self._fail_pending_negotiated(RanksLostError(
+                    [0], reason="negotiation control plane unreachable: "
+                                f"{exc}"))
                 self._unannounced = None
                 self._negotiation_dead = True
                 try:
@@ -655,6 +665,16 @@ class EagerCoordinator:
         """Apply coordinator responses strictly in seq order; returns the
         payload bytes executed (the autotuner's numerator)."""
         executed_bytes = 0
+        try:
+            # liveness fail-fast: the coordinator's ledger declared ranks
+            # dead — pending work can never complete, so fail it all
+            # within one cycle of the declaration instead of hanging
+            from . import negotiation as neg
+            neg.raise_if_ranks_lost(resp)
+        except RanksLostError as exc:
+            self._fail_pending_negotiated(exc)
+            self._negotiation_dead = True
+            return 0
         if getattr(resp, "stale_ack", False):
             # this rank fell behind the coordinator's bounded response
             # log (negotiation.py MAX_RESPONSE_LOG): the missed responses

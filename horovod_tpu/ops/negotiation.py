@@ -35,6 +35,7 @@ import threading
 import time
 
 from ..common import hvd_logging as log
+from ..common.exceptions import RanksLostError
 from ..run import network, secret
 
 # ops (mirrors eager.py's constants; import cycle keeps them local)
@@ -173,7 +174,7 @@ class NegotiatedResponse:
 
 class CycleResponse:
     def __init__(self, base_seq, responses, params, shutdown,
-                 stale_ack=False, unknown_ids=()):
+                 stale_ack=False, unknown_ids=(), lost_ranks=()):
         self.base_seq = base_seq      # seq of responses[0]
         self.responses = responses    # list[NegotiatedResponse]
         self.params = params          # (fusion_threshold, cycle_time_ms)
@@ -187,6 +188,11 @@ class CycleResponse:
         # changed-signature resubmission): the worker drops its mapping
         # and re-announces those tensors with full metas
         self.unknown_ids = tuple(unknown_ids)
+        # ranks the coordinator's liveness ledger declared DEAD (silent
+        # past HOROVOD_RANK_LOST_TIMEOUT_SECONDS): the requester must
+        # fail its pending work with RanksLostError naming them — a
+        # bounded fail-fast instead of the legacy stall-warning hang
+        self.lost_ranks = tuple(lost_ranks)
 
 
 def _meta_identical(a, b):
@@ -227,8 +233,22 @@ class CoordinatorService(network.BasicService):
         self._responses = []
         self._base_seq = 0
         self._acks = {}           # rank -> last acknowledged seq
-        self._seen_req = {}       # rank -> last processed request id
+        # rank -> (last processed request id, unknown-id tuple resolved
+        # on its FIRST processing). The unknowns are persisted so a
+        # deduped retry returns the SAME answer the lost response
+        # carried — without this, a dropped response permanently eats
+        # the re-announce signal and the hit tensors hang forever
+        # (ADVICE.md, medium)
+        self._seen_req = {}
         self._shutdown = False
+        # liveness ledger: rank -> monotonic time of its last cycle.
+        # A rank that heartbeated and then went silent past
+        # config.rank_lost_timeout_seconds is declared lost (fail-fast
+        # RanksLostError at every surviving rank) by _liveness_scan.
+        # Ranks never seen are a startup concern owned by the launch
+        # timeouts, not by this ledger.
+        self._last_seen = {}
+        self._lost_ranks = set()
         self._ports = ports
         # Response cache (response_cache.h:43-92): names that EXECUTEd get
         # a monotonically increasing cache id; a steady-state resubmission
@@ -262,6 +282,7 @@ class CoordinatorService(network.BasicService):
             return network.PingResponse(SERVICE_NAME, client_address[0])
         if isinstance(req, CycleRequest):
             with self._lock:
+                self._last_seen[req.rank] = time.monotonic()
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
                 # Hits resolve ONLY on the first processing of a request
@@ -270,12 +291,15 @@ class CoordinatorService(network.BasicService):
                 # would scan as unknown — making the worker re-announce a
                 # name that may already be negotiated away, the exact
                 # ghost-row hazard the req_id dedupe exists to prevent.
-                # (If the unknowns themselves were lost with the first
-                # response, the worker's next hit under a NEW req_id
-                # rediscovers them.)
-                unknown = []
-                if self._seen_req.get(req.rank) != req.req_id:
-                    self._seen_req[req.rank] = req.req_id
+                # The resolved unknowns are PERSISTED with the req_id and
+                # returned verbatim on deduped retries: the first
+                # response may have been lost on the wire, and an empty
+                # unknown list on the retry would silently eat the
+                # re-announce signal — the hit tensors would then wait in
+                # _negotiated_pending forever (ADVICE.md, medium).
+                seen = self._seen_req.get(req.rank)
+                if seen is None or seen[0] != req.req_id:
+                    unknown = []
                     self._submit(req.rank, req.entries)
                     for cid in decode_hits(req.hits):
                         meta = self._cache.get(cid)
@@ -284,6 +308,10 @@ class CoordinatorService(network.BasicService):
                         else:
                             self._cache.move_to_end(cid)
                             self._submit(req.rank, [meta])
+                    self._seen_req[req.rank] = (req.req_id,
+                                                tuple(unknown))
+                else:
+                    unknown = list(seen[1])
                 self._negotiate()
                 # the shutdown flag is set AFTER this request's negotiate:
                 # work that became ready in the departing rank's final
@@ -301,7 +329,8 @@ class CoordinatorService(network.BasicService):
                     (self._config.fusion_threshold,
                      self._config.cycle_time_ms),
                     self._shutdown, stale_ack=stale,
-                    unknown_ids=unknown)
+                    unknown_ids=unknown,
+                    lost_ranks=sorted(self._lost_ranks))
         raise NotImplementedError(req)
 
     # retained-response cap: a rank that crashed (or never reaches the
@@ -474,10 +503,11 @@ class CoordinatorService(network.BasicService):
         return ids
 
     def _stall_scan(self):
+        now = time.monotonic()
+        self._liveness_scan(now)
         warn = self._config.stall_warning_time_seconds
         if self._config.stall_check_disable or warn <= 0:
             return
-        now = time.monotonic()
         for name in self._order:
             row = self._table[name]
             if not row.warned and now - row.first_ts > warn:
@@ -489,6 +519,57 @@ class CoordinatorService(network.BasicService):
                     "gathered or broadcasted by subset of ranks and are "
                     "waiting for remainder of ranks for more than %ss: "
                     "%s (missing ranks: %s)", warn, name, missing)
+
+    def _liveness_scan(self, now):
+        """Escalate silence to fail-fast: a rank that heartbeated at
+        least once and then sent nothing for
+        ``rank_lost_timeout_seconds`` is declared LOST. Every pending
+        table row becomes an ERROR response naming the dead ranks, and
+        every subsequent CycleResponse carries ``lost_ranks`` so each
+        surviving rank fails its pending work with RanksLostError within
+        one cycle — a bounded abort where the legacy behavior was a
+        stall warning and an indefinite hang.
+
+        Runs inside request handling, which suffices: workers cycle
+        unconditionally at cycle cadence (heartbeats), so while anyone
+        is alive to care, scans happen. Disabled once a clean shutdown
+        drain starts — a departed rank is not a dead rank.
+        """
+        deadline = getattr(self._config, "rank_lost_timeout_seconds", 0.0)
+        if deadline <= 0 or self._shutdown or self._lost_ranks:
+            return
+        dead = sorted(r for r, ts in self._last_seen.items()
+                      if now - ts > deadline)
+        if not dead:
+            return
+        self._lost_ranks = set(dead)
+        log.error(
+            "negotiation liveness: ranks %s sent no cycle for more than "
+            "%ss — declaring them LOST and failing all pending work "
+            "(%d tensors). Survivors receive RanksLostError.",
+            dead, deadline, len(self._order))
+        reason = (f"ranks {dead} sent no negotiation cycle for more "
+                  f"than {deadline}s")
+        for name in self._order:
+            row = self._table.pop(name)
+            op = next(iter(row.metas.values())).op
+            self._responses.append(NegotiatedResponse(
+                NegotiatedResponse.ERROR, op, [name],
+                error=f"RanksLostError: {op} '{name}' cannot complete: "
+                      f"{reason}."))
+        self._order = []
+
+
+def raise_if_ranks_lost(resp):
+    """The worker half of the liveness protocol: fail fast when the
+    coordinator declared ranks dead. Shared by the eager engine
+    (_apply_cycle_response) and the protocol-level chaos drills so both
+    exercise the same path."""
+    lost = getattr(resp, "lost_ranks", ())
+    if lost:
+        raise RanksLostError(
+            lost, reason="declared lost by the coordinator's liveness "
+                         "ledger")
 
 
 def control_addresses():

@@ -65,13 +65,23 @@ class ElasticSupervisor:
     demand."""
 
     def __init__(self, hosts, command, ports=DEFAULT_PORTS, verbose=1,
-                 runner=None):
+                 runner=None, auto_shrink_rc=None, shrink_slots=1,
+                 max_restarts=10):
         self.hosts = parse_hosts(hosts) if isinstance(hosts, str) else hosts
         self.command = list(command)
         self.starting_total = sum(h.slots for h in self.hosts)
         self.current_total = self.starting_total
         self.ports = ports
         self.verbose = verbose
+        # fail-fast consumption: when the job exits with this code (the
+        # RanksLostError.EXIT_CODE contract — workers that lost ranks
+        # exit with it), shrink by shrink_slots and restart instead of
+        # surfacing the failure to a human. None disables. max_restarts
+        # bounds the kill/shrink loop so a systematically crashing job
+        # cannot shrink-restart forever.
+        self.auto_shrink_rc = auto_shrink_rc
+        self.shrink_slots = shrink_slots
+        self.max_restarts = max_restarts
         self.restarts = 0
         self._exit_code = 0
         self._proc = None
@@ -153,17 +163,20 @@ class ElasticSupervisor:
     def remove_slots(self, n, source="local"):
         """Shrink by n slots and restart the job (submitjob listener)."""
         with self._lock:
-            new_hosts, new_total = shrink_hosts(self.hosts, n,
-                                                self.starting_total)
-            if self.verbose:
-                print(f"elastic: request from {source}: slots "
-                      f"{self.current_total}->{new_total}; "
-                      f"batches-per-allreduce -> "
-                      f"{self.starting_total // new_total}")
-            self.hosts, self.current_total = new_hosts, new_total
-            self._kill_job()
-            self.restarts += 1
-            self._start_job()
+            self._remove_slots_locked(n, source)
+
+    def _remove_slots_locked(self, n, source):
+        new_hosts, new_total = shrink_hosts(self.hosts, n,
+                                            self.starting_total)
+        if self.verbose:
+            print(f"elastic: request from {source}: slots "
+                  f"{self.current_total}->{new_total}; "
+                  f"batches-per-allreduce -> "
+                  f"{self.starting_total // new_total}")
+        self.hosts, self.current_total = new_hosts, new_total
+        self._kill_job()
+        self.restarts += 1
+        self._start_job()
 
     def start(self):
         self._sock = self._bind()
@@ -176,7 +189,14 @@ class ElasticSupervisor:
 
     def wait(self, poll_s=0.5):
         """Block until the job exits on its own (not via a restart kill).
-        Returns its exit code."""
+        Returns its exit code.
+
+        Fail-fast consumption: an exit with ``auto_shrink_rc`` (workers
+        lost ranks — RanksLostError.EXIT_CODE) triggers an automatic
+        shrink-and-restart, bounded by ``max_restarts``, instead of
+        returning: the supervisor recovers around dead ranks without a
+        human in the loop (the checkpoint + broadcast_parameters restart
+        contract supplies correctness, as for manual shrinks)."""
         while not self._stop.is_set():
             with self._lock:
                 proc = self._proc
@@ -188,9 +208,24 @@ class ElasticSupervisor:
             except subprocess.TimeoutExpired:
                 continue
             with self._lock:
-                if proc is self._proc:  # exited, not replaced by a restart
-                    self.shutdown()
-                    return rc
+                if proc is not self._proc:  # replaced by a restart kill
+                    continue
+                if (self.auto_shrink_rc is not None and
+                        rc == self.auto_shrink_rc and
+                        self.restarts < self.max_restarts):
+                    if self.verbose:
+                        print(f"elastic: job exited with the ranks-lost "
+                              f"code {rc}; auto-shrinking by "
+                              f"{self.shrink_slots} slot(s)")
+                    try:
+                        self._remove_slots_locked(self.shrink_slots,
+                                                  source="ranks-lost")
+                        continue
+                    except ValueError as e:
+                        print(f"elastic: ERROR: cannot shrink further: "
+                              f"{e}")
+                self.shutdown()
+                return rc
         return self._exit_code
 
     def shutdown(self):
@@ -212,14 +247,27 @@ def main(argv=None):
                     "placeholders.")
     p.add_argument("-H", "--hosts", required=True)
     p.add_argument("--ports", default=",".join(map(str, DEFAULT_PORTS)))
+    p.add_argument("--auto-shrink-on-ranks-lost", action="store_true",
+                   help="When the job exits with RanksLostError's exit "
+                        "code (workers declared ranks dead), shrink and "
+                        "restart automatically instead of exiting.")
+    p.add_argument("--shrink-slots", type=int, default=1,
+                   help="Slots to drop per automatic shrink (default 1).")
+    p.add_argument("--max-restarts", type=int, default=10,
+                   help="Bound on automatic shrink-restarts (default 10).")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     command = args.command[1:] if args.command[:1] == ["--"] else args.command
     if not command:
         p.error("no command given")
+    from ..common.exceptions import RanksLostError
     sup = ElasticSupervisor(
         args.hosts, command,
-        ports=tuple(int(x) for x in args.ports.split(","))).start()
+        ports=tuple(int(x) for x in args.ports.split(",")),
+        auto_shrink_rc=(RanksLostError.EXIT_CODE
+                        if args.auto_shrink_on_ranks_lost else None),
+        shrink_slots=args.shrink_slots,
+        max_restarts=args.max_restarts).start()
     print(f"elastic: listening on port {sup.port}; send an integer to "
           f"surrender that many slots (echo 2 | nc <host> {sup.port})")
     raise SystemExit(sup.wait())

@@ -173,7 +173,12 @@ def worker_main():
     fn, args, kwargs = client.fetch_function()
     try:
         result = fn(*args, **kwargs)
-    except BaseException:
+    except BaseException as exc:
+        from ..common.exceptions import RanksLostError
         client.report(rank, False, traceback.format_exc())
-        sys.exit(1)
+        # a liveness fail-fast exits with its dedicated code so the
+        # launcher (and an elastic supervisor above it) can tell "ranks
+        # died" from a generic failure and auto-shrink instead of paging
+        sys.exit(RanksLostError.EXIT_CODE
+                 if isinstance(exc, RanksLostError) else 1)
     client.report(rank, True, result)

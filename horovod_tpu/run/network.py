@@ -14,10 +14,12 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
 import cloudpickle
 import psutil
 
+from . import chaos as chaos_mod
 from . import secret
 
 
@@ -60,6 +62,20 @@ class Wire:
         wfile.flush()
         with self._count_lock:
             self.bytes_out += secret.DIGEST_LENGTH + 4 + len(body)
+
+    def write_truncated(self, obj, wfile, frac=0.5):
+        """Chaos-plane helper (run/chaos.py truncate_response): write a
+        deliberately cut-off frame — digest/length promise a full body
+        that never arrives, so the peer's read sees a mid-message
+        disconnect (EOFError), never a bogus HMAC failure."""
+        body = cloudpickle.dumps(obj)
+        frame = (secret.compute_digest(self._key, body) +
+                 struct.pack("i", len(body)) + body)
+        cut = max(1, int(len(frame) * frac))
+        wfile.write(frame[:cut])
+        wfile.flush()
+        with self._count_lock:
+            self.bytes_out += cut
 
     def read(self, rfile):
         digest = rfile.read(secret.DIGEST_LENGTH)
@@ -133,6 +149,11 @@ class BasicService:
     def __init__(self, service_name, key):
         self._service_name = service_name
         self._wire = Wire(key)
+        # chaos plane (run/chaos.py): None in production (HVD_CHAOS_SPEC
+        # unset); under a drill, the seeded fault injector for this
+        # service. Evaluated once here so every handler thread shares one
+        # deterministic rule state.
+        self._chaos = chaos_mod.from_env(service_name)
         # live persistent connections: shutdown() must sever them, or
         # clients looping on an established socket would keep being
         # served by daemon handler threads after the accept loop stops
@@ -192,10 +213,47 @@ class BasicService:
                 try:
                     while True:
                         req = service._wire.read(self.rfile)
+                        cz = service._chaos
+                        fault = (cz.decide("request", type(req).__name__)
+                                 if cz else None)
+                        if fault == "drop_request":
+                            # sever BEFORE the handler: the request is
+                            # lost on the way in, no state applied — the
+                            # client sees EOF and owns the retry
+                            break
+                        if fault == "delay_request":
+                            time.sleep(cz.delay_s)
                         resp = service._handle(req, self.client_address)
+                        if fault == "dup_request":
+                            # network-level duplicate delivery: the
+                            # handler runs twice; only a dedup'ing
+                            # service (req_id) survives unchanged
+                            resp = service._handle(req,
+                                                   self.client_address)
                         if resp is None:
                             raise RuntimeError(
                                 "Handler returned no response.")
+                        fault = (cz.decide("response",
+                                           type(resp).__name__)
+                                 if cz else None)
+                        if fault == "drop_response":
+                            # state WAS applied; the response is lost —
+                            # the ADVICE.md class of hang, now a drill
+                            break
+                        if fault == "truncate_response":
+                            service._wire.write_truncated(resp,
+                                                          self.wfile)
+                            break
+                        if fault == "reset":
+                            try:
+                                self.connection.setsockopt(
+                                    socket.SOL_SOCKET, socket.SO_LINGER,
+                                    struct.pack("ii", 1, 0))
+                            except OSError:
+                                pass
+                            break  # close with RST: peer sees ECONNRESET
+                        if fault == "delay_response":
+                            time.sleep(cz.delay_s)
                         service._wire.write(resp, self.wfile)
                 except (EOFError, ConnectionError, struct.error):
                     pass
@@ -240,7 +298,8 @@ class BasicClient:
     """
 
     def __init__(self, service_name, addresses, key, probe_timeout=5.0,
-                 attempts=3, retry_requests=False):
+                 attempts=3, retry_requests=False, retry_attempts=3,
+                 backoff_base_s=0.05, backoff_cap_s=1.0):
         self._service_name = service_name
         self._wire = Wire(key)
         self._timeout = probe_timeout
@@ -253,13 +312,34 @@ class BasicClient:
         # commands) must see the failure instead — its caller owns the
         # retry policy.
         self._retry_requests = retry_requests
-        for _ in range(attempts):
+        self._retry_attempts = max(0, retry_attempts)
+        # capped exponential backoff with full jitter between resends
+        # (and probe rounds): under a real outage every client of a
+        # service retries at once, and synchronized retries turn the
+        # recovering server's accept queue into a thundering herd. The
+        # jitter RNG is deliberately UNSEEDED — decorrelating clients is
+        # the whole point (chaos drills get their determinism from the
+        # server-side injector, not from retry timing).
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        self._backoff_rng = random.Random()
+        for attempt in range(attempts):
             self._addr = self._probe(addresses)
             if self._addr:
                 break
+            if attempt < attempts - 1:
+                time.sleep(self._backoff_delay(attempt))
         if self._addr is None:
             raise NoValidAddressesFound(
                 f"Unable to connect to {service_name} at any of {addresses}")
+
+    def _backoff_delay(self, attempt):
+        """Delay before retry #attempt+1: full-jitter exponential —
+        uniform in [0, min(base * 2^attempt, cap)], so the delay is
+        bounded by the cap and clients spread out instead of herding."""
+        return self._backoff_rng.uniform(
+            0.0, min(self._backoff_base_s * (2 ** attempt),
+                     self._backoff_cap_s))
 
     def _probe(self, addresses):
         results = queue.Queue()
@@ -316,10 +396,12 @@ class BasicClient:
         server's handler loops per connection): high-cadence callers —
         the 5 ms negotiation cycle — skip a TCP handshake per request.
         A dead socket closes and, when ``retry_requests`` (dedup-safe
-        services only), gets one silent reconnect-and-resend; otherwise
-        the error propagates and the NEXT request reconnects."""
+        services only), gets up to ``retry_attempts`` silent
+        reconnect-and-resends under capped-exponential-with-jitter
+        backoff (``_backoff_delay``); otherwise the error propagates and
+        the NEXT request reconnects."""
         with self._req_lock:
-            last = 1 if self._retry_requests else 0
+            last = self._retry_attempts if self._retry_requests else 0
             for attempt in range(last + 1):
                 try:
                     if self._sock is None:
@@ -330,6 +412,7 @@ class BasicClient:
                     self._close_persistent()
                     if attempt == last:
                         raise
+                    time.sleep(self._backoff_delay(attempt))
                 except BaseException:
                     # unexpected failure (e.g. a genuine HMAC mismatch):
                     # the stream position is undefined — never reuse it

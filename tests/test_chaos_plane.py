@@ -1,0 +1,544 @@
+"""Chaos-plane drills: deterministic fault injection on the control-plane
+transport (run/chaos.py + the hooks in run/network.py), failure detection
+(the coordinator's liveness ledger, ops/negotiation.py), and bounded-time
+recovery (BasicClient backoff/resend, RanksLostError fail-fast, elastic
+auto-shrink).
+
+Every test here is CPU-only, multi-PROCESS at most over the TCP control
+plane (never the jax data plane — multiprocess XLA collectives do not
+exist on the CPU backend), and bounded by explicit deadlines: the whole
+point of the chaos plane is that no failure mode is allowed to hang, so
+no drill is allowed to either.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import horovod_tpu
+from horovod_tpu.common.config import HorovodConfig
+from horovod_tpu.common.exceptions import RanksLostError
+from horovod_tpu.ops import negotiation as neg
+from horovod_tpu.run import chaos, network
+from horovod_tpu.run.elastic import ElasticSupervisor
+from horovod_tpu.run.launch import run
+
+KEY = b"k" * 32
+
+_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+def _config(**kw):
+    kw.setdefault("fusion_threshold", 0)
+    kw.setdefault("stall_warning_time_seconds", 0)
+    return HorovodConfig(**kw)
+
+
+def _addr_map(port):
+    return {"local": [("127.0.0.1", port)]}
+
+
+# module-level so they pickle by reference on the wire
+class ApplyRequest:
+    def __init__(self, req_id):
+        self.req_id = req_id
+
+
+class ApplyReply:
+    def __init__(self, req_id):
+        self.req_id = req_id
+
+
+class CountingService(network.BasicService):
+    """Minimal non-dedup'ing service: records every application so tests
+    can distinguish applied-once from applied-twice under faults."""
+
+    NAME = "chaos.counting"
+
+    def __init__(self, key):
+        self.applied = []
+        super().__init__(self.NAME, key)
+
+    def _handle(self, req, client_address):
+        if isinstance(req, ApplyRequest):
+            self.applied.append(req.req_id)
+            return ApplyReply(req.req_id)
+        return super()._handle(req, client_address)
+
+
+@pytest.mark.chaos
+class TestChaosSpec:
+    def test_malformed_rules_raise(self):
+        for bad in ("svc:Msg:drop_request",          # missing prob
+                    "svc:Msg:no_such_fault:0.5",     # unknown fault
+                    "svc:Msg:drop_request:1.5",      # prob out of range
+                    "svc:drop_request:0.5"):         # missing field
+            with pytest.raises(ValueError):
+                chaos.parse_spec(bad, 0)
+
+    def test_blank_spec_is_empty(self):
+        assert chaos.parse_spec("", 0) == []
+        assert chaos.parse_spec(" ; ;", 0) == []
+
+    def test_same_seed_same_decisions(self):
+        spec = "s:Resp:drop_response:0.3"
+
+        def draws(seed):
+            (rule,) = chaos.parse_spec(spec, seed)
+            return [rule.fire() for _ in range(200)]
+
+        assert draws(7) == draws(7)
+        assert draws(7) != draws(8)
+
+    def test_count_caps_total_injections(self):
+        (rule,) = chaos.parse_spec("s:Req:drop_request:1.0:3", 0)
+        assert sum(rule.fire() for _ in range(50)) == 3
+        assert rule.injected == 3
+
+    def test_injector_filters_by_service(self):
+        rules = chaos.parse_spec("hvd.negotiation:*:drop_request:1.0", 0)
+        assert not chaos.ChaosInjector("chaos.counting", rules, 50.0)
+        inj = chaos.ChaosInjector("hvd.negotiation", rules, 50.0)
+        assert inj and inj.decide("request", "CycleRequest") == \
+            "drop_request"
+        # response-side points never match a request-side fault
+        assert inj.decide("response", "CycleResponse") is None
+
+    def test_from_env_without_spec_is_none(self):
+        assert "HVD_CHAOS_SPEC" not in os.environ
+        assert "HOROVOD_CHAOS_SPEC" not in os.environ
+        assert chaos.from_env("hvd.negotiation") is None
+
+
+@pytest.mark.chaos
+class TestClientBackoff:
+    def test_full_jitter_bounded_by_cap(self):
+        svc = network.BasicService("chaos.backoff", KEY)
+        try:
+            c = network.BasicClient("chaos.backoff", _addr_map(svc.port),
+                                    KEY)
+            for attempt in range(12):
+                bound = min(0.05 * 2 ** attempt, 1.0)
+                for _ in range(8):
+                    d = c._backoff_delay(attempt)
+                    assert 0.0 <= d <= bound + 1e-9
+            # far past the cap crossover: still bounded, no overflow
+            assert all(c._backoff_delay(60) <= 1.0 for _ in range(20))
+            c.close()
+        finally:
+            svc.shutdown()
+
+
+@pytest.mark.chaos
+class TestInjectedTransportFaults:
+    def test_retry_resends_same_request_verbatim(self, monkeypatch):
+        """drop_response with transport retry: the client silently
+        reconnects and resends the IDENTICAL request (same req_id on the
+        wire) — the property that makes server-side req_id dedup
+        sufficient for end-to-end exactly-once."""
+        monkeypatch.setenv("HVD_CHAOS_SPEC",
+                           "chaos.counting:ApplyReply:drop_response:1.0:1")
+        svc = CountingService(KEY)
+        try:
+            c = network.BasicClient(CountingService.NAME,
+                                    _addr_map(svc.port), KEY,
+                                    retry_requests=True,
+                                    backoff_base_s=0.01)
+            resp = c.request(ApplyRequest(7))
+            assert isinstance(resp, ApplyReply) and resp.req_id == 7
+            # the handler ran twice (apply-then-lose, then the resend);
+            # both applications carried the same id
+            assert svc.applied == [7, 7]
+            assert sum(svc._chaos.stats().values()) == 1
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_no_retry_never_double_applies(self, monkeypatch):
+        """retry_requests=False: a lost response surfaces as a transport
+        error and the request is NOT resent — a non-idempotent service
+        sees exactly one application."""
+        monkeypatch.setenv("HVD_CHAOS_SPEC",
+                           "chaos.counting:ApplyReply:drop_response:1.0:1")
+        svc = CountingService(KEY)
+        try:
+            c = network.BasicClient(CountingService.NAME,
+                                    _addr_map(svc.port), KEY)
+            with pytest.raises((OSError, EOFError)):
+                c.request(ApplyRequest(9))
+            assert svc.applied == [9]
+            # the rule's count is spent: the next request goes through
+            assert c.request(ApplyRequest(10)).req_id == 10
+            assert svc.applied == [9, 10]
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_truncated_response_reads_as_eof_not_hmac_failure(
+            self, monkeypatch):
+        monkeypatch.setenv(
+            "HVD_CHAOS_SPEC",
+            "chaos.counting:ApplyReply:truncate_response:1.0:1")
+        svc = CountingService(KEY)
+        try:
+            c = network.BasicClient(CountingService.NAME,
+                                    _addr_map(svc.port), KEY)
+            # a mid-frame cut must read as a disconnect (EOFError, which
+            # retry logic handles), never as RuntimeError("Security
+            # error...") — misdiagnosing faults as auth failures would
+            # make every flaky link look like an attack
+            with pytest.raises(EOFError):
+                c.request(ApplyRequest(1))
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_connection_reset_surfaces_as_oserror(self, monkeypatch):
+        monkeypatch.setenv("HVD_CHAOS_SPEC",
+                           "chaos.counting:ApplyReply:reset:1.0:1")
+        svc = CountingService(KEY)
+        try:
+            c = network.BasicClient(CountingService.NAME,
+                                    _addr_map(svc.port), KEY)
+            with pytest.raises((OSError, EOFError)):
+                c.request(ApplyRequest(1))
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_delay_response_is_bounded_by_knob(self, monkeypatch):
+        monkeypatch.setenv("HVD_CHAOS_SPEC",
+                           "chaos.counting:ApplyReply:delay_response:1.0:1")
+        monkeypatch.setenv("HVD_CHAOS_DELAY_MS", "200")
+        svc = CountingService(KEY)
+        try:
+            c = network.BasicClient(CountingService.NAME,
+                                    _addr_map(svc.port), KEY)
+            t0 = time.monotonic()
+            assert c.request(ApplyRequest(3)).req_id == 3
+            assert time.monotonic() - t0 >= 0.15
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_dup_request_deduped_by_coordinator_req_id(self, monkeypatch):
+        """Network-level duplicate delivery of a CycleRequest: the
+        handler runs twice, the req_id dedupe collapses it to one
+        submission — total ordered work stays exactly one response."""
+        monkeypatch.setenv("HVD_CHAOS_SPEC",
+                           "hvd.negotiation:CycleRequest:dup_request:1.0:1")
+        svc = neg.CoordinatorService(1, KEY, ports=[0], config=_config())
+        try:
+            c = network.BasicClient(neg.SERVICE_NAME, _addr_map(svc.port),
+                                    KEY)
+            m = neg.EntryMeta("a", "allreduce", "float32", (4,), 0, False)
+            resp = c.request(neg.CycleRequest(0, [m], -1, req_id=1))
+            assert sum(svc._chaos.stats().values()) == 1
+            assert svc._base_seq + len(svc._responses) == 1
+            (r,) = resp.responses
+            assert r.kind == r.EXECUTE and r.names == ["a"]
+            c.close()
+        finally:
+            svc.shutdown()
+
+
+@pytest.mark.chaos
+class TestLostResponseInjected:
+    def test_dropped_unknown_ids_survive_transport_retry(self, monkeypatch):
+        """The ADVICE.md lost-response bug, reproduced with a REAL
+        injected fault end-to-end: the first CycleResponse (carrying
+        unknown_ids) is dropped on the wire, the client's transport
+        retry resends the same req_id, and the deduped retry must return
+        the PERSISTED unknown-id verdict. On the pre-fix coordinator the
+        retry answered unknown_ids=() and the hit tensors hung forever —
+        this test fails on that code."""
+        monkeypatch.setenv(
+            "HVD_CHAOS_SPEC",
+            "hvd.negotiation:CycleResponse:drop_response:1.0:1")
+        svc = neg.CoordinatorService(2, KEY, ports=[0], config=_config())
+        try:
+            c = network.BasicClient(neg.SERVICE_NAME, _addr_map(svc.port),
+                                    KEY, retry_requests=True,
+                                    backoff_base_s=0.01)
+            resp = c.request(neg.CycleRequest(
+                0, [], -1, req_id=1, hits=neg.encode_hits([5])))
+            assert sum(svc._chaos.stats().values()) == 1  # fault DID fire
+            assert resp.unknown_ids == (5,)
+            assert svc._seen_req[0] == (1, (5,))
+            c.close()
+        finally:
+            svc.shutdown()
+
+
+@pytest.mark.chaos
+class TestDrillDropResponses:
+    def test_negotiation_completes_under_20pct_response_loss(self):
+        """Drill (a): 3 real processes negotiate 10 tensors over TCP
+        while the coordinator drops 20% of CycleResponses. Required
+        outcome: every rank applies the SAME execution order for all 10
+        tensors within the deadline — loss slows the control plane, it
+        never wedges or reorders it."""
+        ports = set()
+        while len(ports) < 3:
+            ports.add(network.free_port())
+        ports_env = ",".join(str(p) for p in sorted(ports))
+
+        def fn():
+            import os
+            import time
+
+            from horovod_tpu.common.config import HorovodConfig
+            from horovod_tpu.ops import negotiation as neg
+
+            rank = int(os.environ.get("HVD_PROCESS_ID", "0"))
+            nproc = 3
+            addresses = [("127.0.0.1", int(p)) for p in
+                         os.environ["HVD_CHAOS_DRILL_PORTS"].split(",")]
+            cfg = HorovodConfig(fusion_threshold=0,
+                                stall_warning_time_seconds=0)
+            worker = neg.NegotiationWorker(rank, nproc, cfg, addresses,
+                                           neg.control_key(),
+                                           start_timeout_s=60.0)
+            names = [f"g{i}" for i in range(10)]
+            entries = [neg.EntryMeta(n, "allreduce", "float32", (4,), 0,
+                                     False) for n in names]
+            applied, ack, req_id = [], -1, 1
+            deadline = time.monotonic() + 60.0
+            while len(applied) < len(names):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"rank {rank}: drill deadline exceeded with only "
+                        f"{applied} applied")
+                try:
+                    resp = worker.cycle(entries, ack, req_id=req_id)
+                except (OSError, EOFError):
+                    # transport retries exhausted: retry the SAME req_id
+                    # (the dedupe token) so a half-applied cycle cannot
+                    # double-submit
+                    time.sleep(0.05)
+                    continue
+                entries = []  # recorded server-side under this req_id
+                req_id += 1
+                for i, r in enumerate(resp.responses):
+                    seq = resp.base_seq + i
+                    if seq <= ack:
+                        continue
+                    assert seq == ack + 1, "gap in the response log"
+                    assert r.kind == r.EXECUTE, r.error
+                    applied.extend(r.names)
+                    ack = seq
+                time.sleep(0.005)
+            # final heartbeat delivers ack=9 (the request always lands;
+            # only responses are being dropped)
+            for _ in range(5):
+                try:
+                    worker.cycle([], ack, req_id=req_id)
+                    break
+                except (OSError, EOFError):
+                    time.sleep(0.05)
+            stats = None
+            if rank == 0:
+                svc = worker.service
+                deadline = time.monotonic() + 60.0
+                while not (len(svc._acks) == nproc and
+                           min(svc._acks.values()) >= len(names) - 1):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"acks never converged: {svc._acks}")
+                    time.sleep(0.02)
+                stats = svc._chaos.stats()
+            worker.close(linger_s=0.5)
+            return applied, stats
+
+        env = dict(_ENV)
+        env["HVD_CHAOS_DRILL_PORTS"] = ports_env
+        env["HVD_CHAOS_SPEC"] = \
+            "hvd.negotiation:CycleResponse:drop_response:0.2"
+        env["HVD_CHAOS_SEED"] = "1234"
+        t0 = time.monotonic()
+        results = run(fn, num_proc=3, env=env, start_timeout_s=180.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 120.0, f"drill took {elapsed:.1f}s"
+        orders = [applied for applied, _ in results]
+        assert sorted(orders[0]) == [f"g{i}" for i in range(10)]
+        assert orders[1] == orders[0] and orders[2] == orders[0]
+        stats = results[0][1]
+        assert stats is not None and sum(stats.values()) > 0, \
+            f"the drill injected nothing: {stats}"
+
+
+_VICTIM_SCRIPT = r"""
+import sys, time
+from horovod_tpu.common.config import HorovodConfig
+from horovod_tpu.ops import negotiation as neg
+
+port = int(sys.argv[1])
+cfg = HorovodConfig(fusion_threshold=0, stall_warning_time_seconds=0)
+w = neg.NegotiationWorker(1, 3, cfg, [("127.0.0.1", port)], b"k" * 32,
+                          start_timeout_s=30.0)
+req_id = 1
+while True:  # heartbeat forever, until SIGKILLed by the test
+    try:
+        w.cycle([], -1, req_id=req_id)
+        req_id += 1
+    except Exception:
+        pass
+    time.sleep(0.1)
+"""
+
+
+@pytest.mark.chaos
+class TestDrillWorkerKilled:
+    def test_killed_rank_fails_fast_with_ranks_lost(self):
+        """Drill (b): SIGKILL one worker mid-negotiation. Survivors must
+        receive RanksLostError NAMING the dead rank within a bounded
+        interval — never the legacy stall-warning hang — and the
+        coordinator must fail the pending work it can no longer
+        complete."""
+        cfg = _config(rank_lost_timeout_seconds=1.5)
+        svc = neg.CoordinatorService(3, KEY, ports=[0], config=cfg)
+        victim = worker2 = None
+        try:
+            venv = dict(os.environ)
+            venv["JAX_PLATFORMS"] = "cpu"
+            venv["PALLAS_AXON_POOL_IPS"] = ""
+            venv["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(horovod_tpu.__file__))] +
+                venv.get("PYTHONPATH", "").split(os.pathsep))
+            victim = subprocess.Popen(
+                [sys.executable, "-c", _VICTIM_SCRIPT, str(svc.port)],
+                env=venv, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            # rank 1 is "up" once its first heartbeat lands in the ledger
+            deadline = time.monotonic() + 60.0
+            while 1 not in svc._last_seen:
+                assert time.monotonic() < deadline, \
+                    "victim never heartbeated"
+                assert victim.poll() is None, \
+                    f"victim died early (rc={victim.poll()})"
+                time.sleep(0.05)
+            worker2 = neg.NegotiationWorker(2, 3, cfg,
+                                            [("127.0.0.1", svc.port)],
+                                            KEY, start_timeout_s=30.0)
+            m = neg.EntryMeta("w", "allreduce", "float32", (4,), 0, False)
+            # ranks 0 and 2 announce "w"; rank 1 never will
+            svc._handle(neg.CycleRequest(0, [m], -1, req_id=1), ("", 0))
+            resp = worker2.cycle([m], -1, req_id=1)
+            assert resp.lost_ranks == ()
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(timeout=10.0)
+            t0 = time.monotonic()
+            err = None
+            req_id = 2
+            while time.monotonic() - t0 < 15.0:
+                # both survivors keep cycling (their heartbeats also
+                # drive the coordinator's liveness scan)
+                svc._handle(neg.CycleRequest(0, [], -1, req_id=req_id),
+                            ("", 0))
+                try:
+                    neg.raise_if_ranks_lost(
+                        worker2.cycle([], -1, req_id=req_id))
+                except RanksLostError as e:
+                    err = e
+                    break
+                req_id += 1
+                time.sleep(0.1)
+            elapsed = time.monotonic() - t0
+            assert err is not None, \
+                "survivors never saw RanksLostError (the legacy hang)"
+            assert elapsed < 10.0, f"fail-fast took {elapsed:.1f}s"
+            assert err.ranks == (1,)
+            assert "1" in str(err)
+            # the pending tensor was failed, not stranded
+            errors = [r for r in svc._responses if r.kind == r.ERROR]
+            assert any("RanksLostError" in r.error and r.names == ["w"]
+                       for r in errors), errors
+        finally:
+            if victim is not None and victim.poll() is None:
+                victim.kill()
+                victim.wait(timeout=10.0)
+            if worker2 is not None:
+                worker2.close(linger_s=0.0)
+            svc.shutdown()
+
+
+class _ExitedProc:
+    """A job process that has already exited with a scripted code."""
+
+    def __init__(self, rc):
+        self._rc = rc
+        self.pid = os.getpid()
+
+    def wait(self, timeout=None):
+        return self._rc
+
+    def poll(self):
+        return self._rc
+
+
+@pytest.mark.chaos
+class TestElasticAutoShrink:
+    def _supervisor(self, rcs, calls, hosts="localhost:4", **kw):
+        codes = list(rcs)
+
+        def runner(argv):
+            calls.append(list(argv))
+            return _ExitedProc(codes.pop(0))
+
+        kw.setdefault("auto_shrink_rc", RanksLostError.EXIT_CODE)
+        return ElasticSupervisor(hosts, ["job", "{np}", "{bpa}",
+                                         "{restart}"],
+                                 ports=(0,), verbose=0, runner=runner, **kw)
+
+    def test_ranks_lost_exit_shrinks_and_restarts(self):
+        calls = []
+        sup = self._supervisor([RanksLostError.EXIT_CODE, 0], calls)
+        try:
+            sup.start()
+            assert sup.wait(poll_s=0.01) == 0
+        finally:
+            sup.shutdown()
+        assert sup.restarts == 1
+        # 4 slots, shrink 1 -> 3, then to 2 so 4 % total == 0 (exact
+        # global-batch preservation via batches-per-allreduce)
+        assert sup.current_total == 2
+        assert calls == [["job", "4", "1", "0"], ["job", "2", "2", "1"]]
+
+    def test_other_exit_codes_pass_through(self):
+        calls = []
+        sup = self._supervisor([3], calls)
+        try:
+            sup.start()
+            assert sup.wait(poll_s=0.01) == 3
+        finally:
+            sup.shutdown()
+        assert sup.restarts == 0 and len(calls) == 1
+
+    def test_max_restarts_bounds_the_loop(self):
+        calls = []
+        rc = RanksLostError.EXIT_CODE
+        sup = self._supervisor([rc, rc, rc], calls, max_restarts=2)
+        try:
+            sup.start()
+            # shrinks twice (4 -> 2 -> 1), then surfaces the code
+            assert sup.wait(poll_s=0.01) == rc
+        finally:
+            sup.shutdown()
+        assert sup.restarts == 2 and len(calls) == 3
+
+    def test_unshrinkable_allocation_surfaces_the_code(self):
+        calls = []
+        sup = self._supervisor([RanksLostError.EXIT_CODE], calls,
+                               hosts="localhost:1")
+        try:
+            sup.start()
+            # 1 slot cannot shrink: the failure surfaces instead of
+            # looping
+            assert sup.wait(poll_s=0.01) == RanksLostError.EXIT_CODE
+        finally:
+            sup.shutdown()
+        assert sup.restarts == 0

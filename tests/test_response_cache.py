@@ -152,6 +152,32 @@ class TestCoordinatorCache:
         finally:
             svc.shutdown()
 
+    def test_deduped_retry_returns_persisted_unknown_ids(self):
+        """Lost-response regression (ADVICE.md, medium): the unknown-id
+        verdict is resolved on the FIRST processing of a req_id and must
+        be returned VERBATIM on a deduped retry. Before the fix the
+        retry hit the dedupe arm and answered unknown_ids=() — the
+        worker never learned its hits were stale, and the hit tensors
+        waited in _negotiated_pending forever."""
+        from horovod_tpu.ops.negotiation import CycleRequest
+        svc, neg = self._service()
+        try:
+            hits = neg.encode_hits([5])  # id never assigned: unknown
+            r1 = svc._handle(CycleRequest(0, [], -1, req_id=1, hits=hits),
+                             ("", 0))
+            assert r1.unknown_ids == (5,)
+            # the response above is "lost on the wire"; the transport
+            # retry resends the identical request (same req_id)
+            r2 = svc._handle(CycleRequest(0, [], -1, req_id=1, hits=hits),
+                             ("", 0))
+            assert r2.unknown_ids == (5,), \
+                "deduped retry dropped the unknown-id re-announce signal"
+            # and a NEW req_id re-resolves fresh rather than replaying
+            r3 = svc._handle(CycleRequest(0, [], -1, req_id=2), ("", 0))
+            assert r3.unknown_ids == ()
+        finally:
+            svc.shutdown()
+
     def test_retry_with_hits_is_idempotent(self):
         from horovod_tpu.ops.negotiation import CycleRequest
         svc, neg = self._service()
