@@ -25,7 +25,10 @@ examples/bench_common.py, shared with examples/{synthetic,scaling}_benchmark
 so the harnesses cannot drift.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"transformer_lm": {...}}.
+"transformer_lm": {...}, "autotune": {...}, "flash_ablation": {...},
+"profile": {...}} — flash_ablation holds the per-variant × per-seq
+operating-point table (paired deltas vs the online baseline), profile
+the per-op-class decomposition of one flagship window.
 """
 
 import json
@@ -58,7 +61,7 @@ def _peak_flops(device):
     return best[1] if best else None
 
 
-def _bench_autotune(hvd, n_tensors=8, mb=16):
+def _bench_autotune(hvd, n_tensors=8, mb=16, on_tpu=True):
     """Score the autotuner on the chip (judge r2 item 6, r3 item 1):
     eager fused allreduce bytes/us with defaults vs with
     HOROVOD_AUTOTUNE=1 after its GP/EI exploration, plus the adopted
@@ -194,12 +197,67 @@ def _bench_autotune(hvd, n_tensors=8, mb=16):
         default_rate = float(np.median(d_rates))
         tuned_rate = float(np.median(t_rates))
         kept = tuned_rate >= default_rate
+
+        # REAL-STEP validation: the knobs were explored on synthetic
+        # bursts, but what the tuner is FOR is training throughput — so
+        # the keep/revert decision runs on actual eager-allreduce train
+        # steps (bench_common._eager_step: vmap-stacked grads, one fused
+        # eager allreduce per step — the exact recipe
+        # examples/*.py --eager-allreduce runs). Same paired,
+        # counterbalanced protocol as the burst leg. The burst numbers
+        # stay in the output for r4/r5 comparability; a train-leg failure
+        # falls back to the burst verdict.
+        train = None
+        try:
+            from bench_common import build_eager_lm_step, flagship_config
+            if on_tpu:
+                # 4 layers keeps the leg quick while the gradient payload
+                # (~67M params, embeddings included) stays fusion-scale
+                t_cfg = flagship_config(True, num_layers=4)
+                bps, t_seq = 4, 512
+            else:
+                t_cfg = flagship_config(False)
+                bps, t_seq = 2, 64
+            world = hvd.size()
+            t_step, t_params, t_opt, t_toks = build_eager_lm_step(
+                t_cfg, world, bps, t_seq)
+            for _ in range(2):  # compile both jits + eager fusion plan
+                t_params, t_opt, loss = t_step(t_params, t_opt, t_toks)
+            float(loss)
+            d_ms, t_ms = [], []
+            for rd in range(4):
+                order = ((default_knobs, d_ms), (tuned_knobs, t_ms))
+                if rd % 2:
+                    order = order[::-1]
+                for knobs, sink in order:
+                    cfg.fusion_threshold, cfg.cycle_time_ms = knobs
+                    t0 = time.perf_counter()
+                    t_params, t_opt, loss = t_step(t_params, t_opt, t_toks)
+                    float(loss)
+                    sink.append((time.perf_counter() - t0) * 1e3)
+            t_step = t_params = t_opt = t_toks = None
+            d_med, t_med = float(np.median(d_ms)), float(np.median(t_ms))
+            kept = t_med <= d_med  # train steps decide
+            train = {
+                "default_ms_per_step": round(d_med, 2),
+                "tuned_ms_per_step": round(t_med, 2),
+                "gain_pct": round((d_med / t_med - 1) * 100, 1),
+                "step": f"eager-lm L{t_cfg.num_layers} "
+                        f"b{bps}x{world} s{t_seq}",
+                "kept": kept,
+            }
+        except Exception as e:  # noqa: BLE001 — burst verdict stands
+            print(f"autotune train leg failed: {e}", file=sys.stderr)
+            train = {"error": str(e)[:200]}
+
         if not kept:
             # revert the LIVE knobs: freeze_autotune wrote the adopted
             # point into the coordinator's config, which is what the
             # fusion planner actually reads
             cfg.fusion_threshold = 64 << 20
             cfg.cycle_time_ms = 5.0
+        else:
+            cfg.fusion_threshold, cfg.cycle_time_ms = tuned_knobs
     finally:
         if prior is None:
             os.environ.pop("HOROVOD_AUTOTUNE", None)
@@ -214,10 +272,148 @@ def _bench_autotune(hvd, n_tensors=8, mb=16):
         "gain_pct": round((tuned_rate / default_rate - 1) * 100, 1),
         "burst": f"{n_tensors}x{mb}MB",
         "kept": kept,  # False = tuned point lost validation, reverted
+        "train": train,  # real-step paired validation (decides `kept`)
     }
     if best is not None:
-        out["adopted_threshold_mb"] = round(best[0] / 2**20, 2)
-        out["adopted_cycle_ms"] = round(best[1], 2)
+        # adopted_* report what actually went live (tuned_knobs), which
+        # can differ from the GP's raw best: Autotuner.freeze clamps a
+        # boundary-parked cycle_time back to the default (the r5
+        # cycle_ms=99.22 artifact — see utils/autotune.py)
+        out["adopted_threshold_mb"] = round(tuned_knobs[0] / 2**20, 2)
+        out["adopted_cycle_ms"] = round(tuned_knobs[1], 2)
+        out["raw_best_cycle_ms"] = round(best[1], 2)
+        out["cycle_boundary_clamped"] = bool(
+            getattr(tuner, "cycle_boundary_clamped", False))
+    return out
+
+
+def _bench_profile(window, meta):
+    """Per-op profile decomposition of one flagship transformer window:
+    account for every millisecond of the step — flash kernels, matmuls,
+    collectives, copies, fusions, and the residual (wall minus
+    device-busy = host dispatch + inter-op gaps, the part no per-op row
+    shows). When this process owns a Horovod timeline (HOROVOD_TIMELINE
+    set at init, rank 0) the same capture also writes the merged
+    host+device Chrome trace (utils/merged_timeline) from the SAME
+    profiler session; otherwise a plain jax.profiler trace feeds the
+    arithmetic alone. The trace dir is kept on disk and its path
+    reported so the decomposition can be re-derived from the artifact."""
+    import tempfile
+
+    import jax
+
+    import horovod_tpu.common.state as state
+    from horovod_tpu.utils import merged_timeline, profiling
+
+    pdir = tempfile.mkdtemp(prefix="hvd-bench-profile-")
+    merged_path = os.path.join(pdir, "merged_timeline.json")
+    timeline = getattr(state.global_state().coordinator, "timeline", None)
+    window()  # untimed executable-switch warmup, same role as headline
+    if timeline is not None:
+        with merged_timeline.capture(merged_path, profiler_dir=pdir):
+            wall_s = window()
+    else:
+        with jax.profiler.trace(pdir):
+            wall_s = window()
+        merged_path = None
+    out = profiling.profile_decomposition(
+        pdir, wall_ms=wall_s * 1e3, steps=meta["inner"])
+    out["trace_dir"] = pdir
+    if merged_path:
+        out["merged_timeline"] = merged_path
+    return out
+
+
+def _bench_flash_ablation(on_tpu, peak):
+    """Flash-attention variant ablation: every forward variant
+    (ops/flash_attention.VARIANTS) at the flagship operating points —
+    seq 1024 (the headline shape) and seq 2048 (nk=4: more k tiles for
+    the lazy gate / two-pass trade to act on) — through EXACTLY the
+    headline recipe (setup_transformer_lm pins cfg.flash_variant), so
+    the ablation and the headline number can never measure different
+    setups.
+
+    Protocol is the r5 paired/interleaved one: per operating point the
+    variants' windows run round-by-round in counterbalanced order
+    (forward, reversed, forward, ...), each measurement preceded by an
+    untimed executable-switch window, so the tunneled runtime's
+    minute-scale drift is common-mode. Each variant reports the full
+    transformer_lm_metrics (MFU when peak is known) plus a PAIRED
+    per-round delta vs the online baseline: median ± half-range of the
+    per-round (online_ms/variant_ms - 1) ratios — the number that can be
+    judged against drift, unlike a cross-run MFU comparison."""
+    import jax
+
+    from bench_common import setup_transformer_lm, transformer_lm_metrics
+    from horovod_tpu.ops import flash_attention as fa
+
+    seqs = (1024, 2048) if on_tpu else (64,)
+    rounds = 3 if on_tpu else 1
+    # the env override beats every explicit variant (resolve_variant),
+    # which would silently measure one variant three times here
+    env_override = os.environ.pop("HVD_FLASH_VARIANT", None)
+    out = {}
+    try:
+        for seq in seqs:
+            entry = {"seq": seq}
+            windows = None
+            for bpc in ((None, 8) if on_tpu else (None,)):
+                try:
+                    windows = {}
+                    for v in fa.VARIANTS:
+                        w, m = setup_transformer_lm(
+                            on_tpu, seq=seq, flash_variant=v,
+                            batch_per_chip=bpc)
+                        w()  # compile + warmup
+                        windows[v] = (w, m, [])
+                    if bpc is not None:
+                        entry["batch_per_chip_fallback"] = bpc
+                    break
+                except Exception as e:  # noqa: BLE001 — OOM fallback
+                    windows = None
+                    jax.clear_caches()
+                    if (on_tpu and bpc is None
+                            and "RESOURCE_EXHAUSTED" in str(e)):
+                        print(f"flash ablation seq {seq}: flagship batch "
+                              f"OOM, retrying at 8/chip", file=sys.stderr)
+                        continue
+                    entry["error"] = str(e)[:200]
+                    break
+            if not windows:
+                out[f"seq{seq}"] = entry
+                continue
+            try:
+                for rd in range(rounds):
+                    order = list(fa.VARIANTS)
+                    if rd % 2:
+                        order.reverse()
+                    for v in order:
+                        w, _, sink = windows[v]
+                        w()  # untimed executable-switch window
+                        sink.append(w())
+                for v, (_, m, sink) in windows.items():
+                    entry[v] = transformer_lm_metrics(sink, m,
+                                                      peak_flops=peak)
+                base = windows[fa.VARIANTS[0]][2]
+                for v in fa.VARIANTS[1:]:
+                    d = [(base[i] / windows[v][2][i] - 1) * 100
+                         for i in range(len(base))]
+                    entry[v]["delta_vs_online_pct"] = round(
+                        float(np.median(d)), 2)
+                    entry[v]["delta_pm_pct"] = round(
+                        (max(d) - min(d)) / 2, 2)
+                blk = fa.fit_block(512, seq)
+                entry["auto_variant"] = fa.resolve_variant(
+                    "auto", causal=True, nk=seq // blk)
+            except Exception as e:  # noqa: BLE001 — keep partial point
+                entry["error"] = str(e)[:200]
+            finally:
+                windows = None
+                jax.clear_caches()
+            out[f"seq{seq}"] = entry
+    finally:
+        if env_override is not None:
+            os.environ["HVD_FLASH_VARIANT"] = env_override
     return out
 
 
@@ -240,7 +436,7 @@ def main():
     # ~50x (measured r4: 52 GB/s fresh vs ~1 GB/s after the benches),
     # flattening the tuned-vs-default contrast into noise.
     try:
-        autotune = _bench_autotune(hvd)
+        autotune = _bench_autotune(hvd, on_tpu=on_tpu)
     except Exception as e:  # noqa: BLE001 — headline metrics still print
         print(f"autotune bench failed: {e}", file=sys.stderr)
         autotune = {"error": str(e)[:200]}
@@ -327,6 +523,21 @@ def main():
                 tlm_window = None
                 tlm_err = str(e)
 
+    # Profile decomposition leg: trace one extra flagship window while
+    # its state is still resident (accounts for every ms of the step —
+    # the ceiling argument when the ablation's best variant stalls short
+    # of the MFU target). Default on for TPU; HVD_BENCH_PROFILE=1
+    # forces it on CPU smoke runs, =0 disables.
+    profile = None
+    prof_gate = os.environ.get("HVD_BENCH_PROFILE", "")
+    if tlm_window is not None and (prof_gate == "1"
+                                   or (on_tpu and prof_gate != "0")):
+        try:
+            profile = _bench_profile(tlm_window, tlm_meta)
+        except Exception as e:  # noqa: BLE001 — headline still prints
+            print(f"profile leg failed: {e}", file=sys.stderr)
+            profile = {"error": str(e)[:200]}
+
     img_sec_per_chip = float(np.mean(r_rates)) / n_chips
     value_pm = ((max(r_window_means) - min(r_window_means)) / 2 / n_chips
                 if len(r_window_means) > 1 else 0.0)
@@ -344,6 +555,21 @@ def main():
             print(f"transformer bench failed: {e}", file=sys.stderr)
             tlm = {"error": str(tlm_err or e)[:200]}
 
+    # Flash-variant ablation LAST: it builds three flagship models per
+    # operating point, so the headline state is freed first. Gated like
+    # the profile leg (TPU default on; CPU smoke via =1).
+    flash_ablation = None
+    abl_gate = os.environ.get("HVD_BENCH_FLASH_ABLATION", "")
+    if abl_gate == "1" or (on_tpu and abl_gate != "0"):
+        step = params = opt_state = batch_data = None
+        tlm_window = tlm_meta = None
+        jax.clear_caches()
+        try:
+            flash_ablation = _bench_flash_ablation(on_tpu, peak)
+        except Exception as e:  # noqa: BLE001 — headline still prints
+            print(f"flash ablation failed: {e}", file=sys.stderr)
+            flash_ablation = {"error": str(e)[:200]}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_sec_per_chip, 2),
@@ -353,6 +579,8 @@ def main():
             img_sec_per_chip / BASELINE_IMG_PER_SEC_PER_WORKER, 3),
         "transformer_lm": tlm,
         "autotune": autotune,
+        "flash_ablation": flash_ablation,
+        "profile": profile,
     }))
     return 0
 

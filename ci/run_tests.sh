@@ -9,6 +9,16 @@ cd "$(dirname "$0")/.."
 echo "--- build native core"
 python setup.py build_native
 
+echo "--- kernel numerics (fast fail: flash variants vs reference softmax)"
+# The flash-attention forward variants (online/lazy/twopass) share one
+# backward and one lse contract; a numerics break here poisons every
+# training result, so the small-shape variant suite runs FIRST and
+# fails the pipeline in ~2 min instead of after the full suite's
+# subprocess-heavy half hour. Big shapes are @slow and stay in the
+# nightly `-m slow` run.
+python -m pytest tests/test_flash_variants.py tests/test_flash_attention.py \
+    -q -m "not slow"
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

@@ -103,7 +103,7 @@ def transformer_matmul_flops_per_token(cfg, seq):
     return tr.matmul_flops_per_token(cfg, seq)
 
 
-def flagship_config(on_tpu=True):
+def flagship_config(on_tpu=True, **overrides):
     """The canonical flagship bench model: gpt2_small_tpu — GPT-2-small's
     size/FLOPs with the TPU-native 6x128 head shape (head_dim 128 = the
     lane width, so the flash kernels run unpadded; +18% tok/s over 12x64
@@ -113,13 +113,19 @@ def flagship_config(on_tpu=True):
     logits_fp32=False keeps the [B, S, vocab] logits in bf16 —
     trainer.softmax_cross_entropy still accumulates its logsumexp in
     fp32, only the stored logit values round (measured ~4 ms/step at
-    this scale; docs/benchmarks.md)."""
+    this scale; docs/benchmarks.md). ``overrides`` (e.g. flash_variant,
+    max_seq_len) go straight into the TransformerConfig — the flash
+    ablation leg pins variants through here."""
     from horovod_tpu.models import transformer as tr
 
     if on_tpu:
-        return tr.TransformerConfig.gpt2_small_tpu(
-            attention_impl="flash", tie_embeddings=True, logits_fp32=False)
-    return tr.TransformerConfig.tiny(attention_impl="full")
+        kw = dict(attention_impl="flash", tie_embeddings=True,
+                  logits_fp32=False)
+        kw.update(overrides)
+        return tr.TransformerConfig.gpt2_small_tpu(**kw)
+    kw = dict(attention_impl="full")
+    kw.update(overrides)
+    return tr.TransformerConfig.tiny(**kw)
 
 
 def build_transformer_step(mesh, batch, seq, cfg=None, on_tpu=True,
@@ -165,7 +171,8 @@ def build_transformer_step(mesh, batch, seq, cfg=None, on_tpu=True,
     return step, params, opt_state, toks, cfg
 
 
-def setup_transformer_lm(on_tpu):
+def setup_transformer_lm(on_tpu, seq=None, flash_variant=None,
+                         batch_per_chip=None):
     """Build the flagship-transformer bench (the canonical source of the
     tokens/sec/chip + MFU numbers in bench.py's JSON line and
     docs/benchmarks.md — keep single-sourced so harnesses cannot drift).
@@ -175,6 +182,11 @@ def setup_transformer_lm(on_tpu):
     runtime — is amortized out of the measurement; the loop scans over a
     stacked [n_steps, batch, seq] token array, a real optimizer update
     per inner step.
+
+    ``seq`` / ``flash_variant`` / ``batch_per_chip`` override the
+    flagship defaults — the flash-ablation leg builds one window per
+    (variant, seq) operating point through exactly this recipe, so the
+    ablation and the headline number can never measure different setups.
 
     Returns (window_fn, meta): window_fn() runs one timed window and
     returns seconds/step; the first call includes compile (callers
@@ -186,15 +198,25 @@ def setup_transformer_lm(on_tpu):
     if on_tpu:
         # batch 16 is the measured per-chip sweet spot (r4: 0.632 MFU vs
         # 0.603 at batch 8 and 0.58 at batch 32, docs/benchmarks.md)
-        batch_per_chip, seq, inner = 16, 1024, 10
+        defaults = (16, 1024, 10)
     else:  # CI smoke on CPU: tiny everything, no MFU claim
-        batch_per_chip, seq, inner = 2, 64, 2
+        defaults = (2, 64, 2)
+    batch_per_chip = batch_per_chip or defaults[0]
+    seq = seq or defaults[1]
+    inner = defaults[2]
+
+    overrides = {}
+    if flash_variant is not None:
+        overrides["flash_variant"] = flash_variant
+    if on_tpu and seq > 1024:
+        overrides["max_seq_len"] = seq
+    cfg = flagship_config(on_tpu, **overrides)
 
     n = hvd.size()
     mesh = mesh_mod.build_mesh(dp=n)
     batch = batch_per_chip * n
     step, params, opt_state, toks, cfg = build_transformer_step(
-        mesh, batch, seq, on_tpu=on_tpu, n_steps=inner)
+        mesh, batch, seq, cfg=cfg, on_tpu=on_tpu, n_steps=inner)
     live = {"params": params, "opt": opt_state}
 
     def window():
@@ -206,6 +228,7 @@ def setup_transformer_lm(on_tpu):
 
     meta = {"batch": batch, "batch_per_chip": batch_per_chip, "seq": seq,
             "inner": inner, "cfg": cfg, "n": n,
+            "flash_variant": flash_variant or "auto",
             "model": f"gpt2-small-{'tpu-flash' if on_tpu else 'tiny-smoke'}"}
     return window, meta
 
@@ -244,3 +267,101 @@ def bench_transformer_lm(on_tpu, peak_flops=None):
     windows = 3 if on_tpu else 1
     return transformer_lm_metrics([window() for _ in range(windows)],
                                   meta, peak_flops=peak_flops)
+
+
+# ---------------------------------------------------------------------------
+# Eager-allreduce training steps — the autotuner's regime.
+#
+# The GSPMD steps above average gradients with an in-graph psum, which the
+# eager coordination core (and therefore HOROVOD_AUTOTUNE's passive scorer)
+# never sees. These builders produce the eager form: per-shard gradients
+# computed STACKED — vmap over a [world, per_shard, ...] batch, so every
+# gradient leaf has leading dim == hvd.size() and rides the eager core's
+# fused stacked-allreduce path (ops/eager.py), the exact path the tuner's
+# burst bench exercises — then one optimizer apply on the averaged row.
+# Shared by examples/{transformer_lm,synthetic_benchmark}.py
+# --eager-allreduce and bench.py's autotune train leg, so the tuner is
+# scored on the same step recipe users run.
+# ---------------------------------------------------------------------------
+
+
+def build_eager_lm_step(cfg, world, batch_per_shard, seq, lr=3e-4,
+                        tx=None, params=None):
+    """Transformer train step with EAGER gradient averaging.
+    Returns (step, params, opt_state, toks); step(params, opt_state,
+    toks) -> (params, opt_state, loss), toks [world, batch_per_shard,
+    seq]. Pass ``tx``/``params`` to reuse a caller's optimizer and
+    initialized weights (examples/transformer_lm.py --eager-allreduce)."""
+    import numpy as np
+
+    from horovod_tpu.models import transformer as tr
+
+    model = tr.TransformerLM(cfg)
+    if params is None:
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((2, seq), jnp.int32))["params"]
+    if tx is None:
+        tx = optax.adamw(lr, mu_dtype=jnp.bfloat16)
+    opt_state = tx.init(params)
+    loss_fn = tr.lm_loss_fn(model)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (world, batch_per_shard, seq),
+        dtype=np.int64).astype(np.int32))
+    return (_eager_step(loss_fn, tx), params, opt_state, toks)
+
+
+def build_eager_image_step(model_name, world, batch_per_shard, image_size,
+                           compression=None):
+    """Image-model (ResNet et al) train step with EAGER gradient
+    averaging; batch data is [world, batch_per_shard, H, W, 3]."""
+    from horovod_tpu import models, trainer as trainer_mod
+
+    kwargs = {"dropout_rate": 0.0} if model_name.startswith("vgg") else {}
+    model = models.build(model_name, num_classes=1000, dtype=jnp.bfloat16,
+                         **kwargs)
+    images = jnp.zeros((world, batch_per_shard, image_size, image_size, 3),
+                       jnp.bfloat16)
+    labels = jnp.zeros((world, batch_per_shard), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images[0, :2],
+                           train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, batch):
+        imgs, lbls = batch
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": batch_stats}, imgs, train=True,
+            mutable=["batch_stats"])
+        return trainer_mod.softmax_cross_entropy(logits, lbls)
+
+    step = _eager_step(loss_fn, tx, compression=compression)
+    return step, params, opt_state, (images, labels)
+
+
+def _eager_step(loss_fn, tx, compression=None):
+    """The shared eager-dp step: jitted vmap'd per-shard grads (stacked
+    [world, ...] leaves), ONE eager fused allreduce between compute and
+    apply, jitted apply on the averaged row-0 grads."""
+    grad_fn = jax.jit(jax.vmap(jax.value_and_grad(loss_fn),
+                               in_axes=(None, 0)))
+    compression = compression or hvd.Compression.none
+
+    @jax.jit
+    def apply_fn(params, opt_state, grads):
+        g0 = jax.tree_util.tree_map(lambda g: g[0], grads)
+        updates, opt_state = tx.update(g0, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def step(params, opt_state, batch):
+        losses, grads = grad_fn(params, batch)
+        # the eager core: every leaf is [world, ...] -> stacked kind,
+        # fused by the live fusion_threshold/cycle_time knobs, scored
+        # passively by the autotuner when HOROVOD_AUTOTUNE=1
+        grads = hvd.allreduce_gradients(grads, compression=compression)
+        params, opt_state = apply_fn(params, opt_state, grads)
+        return params, opt_state, jnp.mean(losses)
+
+    return step

@@ -18,7 +18,8 @@ import numpy as np
 import horovod_tpu as hvd
 from horovod_tpu import models
 
-from bench_common import build_step, positive_int, timed_rates
+from bench_common import (build_eager_image_step, build_step, positive_int,
+                          timed_rates)
 
 
 def parse_args():
@@ -35,6 +36,11 @@ def parse_args():
                         "inception3 299)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="bf16 compression on gradient allreduce")
+    p.add_argument("--eager-allreduce", action="store_true",
+                   help="average gradients through the EAGER collective "
+                        "core per step (reference Horovod's regime, and "
+                        "the one HOROVOD_AUTOTUNE scores) instead of the "
+                        "in-graph psum")
     args = p.parse_args()
     if args.image_size is None:
         args.image_size = models.image_size(args.model)
@@ -47,13 +53,22 @@ def main():
     world = hvd.size()
     batch = args.batch_size * world
 
-    step, params, opt_state, batch_data = build_step(
-        args.model, hvd.mesh(), batch, args.image_size,
-        fp16_allreduce=args.fp16_allreduce)
+    if args.eager_allreduce:
+        step, params, opt_state, batch_data = build_eager_image_step(
+            args.model, world, args.batch_size, args.image_size,
+            compression=hvd.Compression.bf16 if args.fp16_allreduce
+            else None)
+    else:
+        step, params, opt_state, batch_data = build_step(
+            args.model, hvd.mesh(), batch, args.image_size,
+            fp16_allreduce=args.fp16_allreduce)
 
     if hvd.process_rank() == 0:
         print(f"Model: {args.model}")
         print(f"Batch size: {args.batch_size} per worker x {world} workers")
+        if args.eager_allreduce:
+            print("Gradient averaging: eager fused allreduce "
+                  "(autotune-scorable)")
 
     def on_iter(i, rate):
         if hvd.process_rank() == 0:

@@ -77,6 +77,13 @@ def parse_args():
                         "entries instead of materializing [B,S,V] logits "
                         "(memory-bound large-batch/long-seq configs)")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--eager-allreduce", action="store_true",
+                   help="average gradients through the EAGER collective "
+                        "core (fused stacked allreduce per step) instead "
+                        "of the in-graph GSPMD psum — the regime "
+                        "HOROVOD_AUTOTUNE's passive scorer observes, so "
+                        "autotuning tunes against these exact steps. "
+                        "Pure data-parallel only (tp/sp/ep must be 1).")
     p.add_argument("--bench", action="store_true",
                    help="skip checkpointing/logging; print tokens/sec")
     p.add_argument("--seed", type=int, default=0)
@@ -118,16 +125,29 @@ def main():
         0.0, args.lr, args.warmup_steps, max(args.steps, 2 * args.warmup_steps))
     tx = optax.adamw(sched, weight_decay=0.01)
 
-    loss_fn = tr.lm_loss_fn(model, vocab_chunk=args.vocab_chunk)
-    specs = tr.param_specs(params)
-    step, param_shardings, batch_sharding = trainer.make_gspmd_step(
-        loss_fn, tx, mesh, specs, tr.batch_spec(sp=args.sp > 1),
-        params=params)
-    params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
-    opt_state = trainer.init_opt_state(tx, params, mesh, specs)
+    if args.eager_allreduce:
+        if args.tp * args.sp * args.ep != 1:
+            raise SystemExit("--eager-allreduce is pure data-parallel: "
+                             "tp/sp/ep must all be 1")
+        from bench_common import build_eager_lm_step
+        step, params, opt_state, _ = build_eager_lm_step(
+            cfg, n, args.batch_size, seq, tx=tx, params=params)
+        if verbose:
+            print("eager allreduce: gradients ride the coordination core "
+                  "(autotune-scorable; HOROVOD_AUTOTUNE=1 to tune)")
+    else:
+        loss_fn = tr.lm_loss_fn(model, vocab_chunk=args.vocab_chunk)
+        specs = tr.param_specs(params)
+        step, param_shardings, batch_sharding = trainer.make_gspmd_step(
+            loss_fn, tx, mesh, specs, tr.batch_spec(sp=args.sp > 1),
+            params=params)
+        params = jax.tree_util.tree_map(jax.device_put, params,
+                                        param_shardings)
+        opt_state = trainer.init_opt_state(tx, params, mesh, specs)
 
     start_step = 0
-    if args.checkpoint_dir and checkpoint.exists(args.checkpoint_dir):
+    if args.checkpoint_dir and not args.eager_allreduce and \
+            checkpoint.exists(args.checkpoint_dir):
         (params, opt_state), start_step = checkpoint.restore(
             args.checkpoint_dir, like=(params, opt_state))
         if verbose:
@@ -136,6 +156,12 @@ def main():
     def batch_tokens():
         # [batch, seq]; the loss shifts inputs/targets internally. seq (not
         # seq+1) keeps the sequence dim divisible by sp for device_put.
+        if args.eager_allreduce:
+            # stacked eager layout: [world, per_shard, seq]
+            toks = rng.randint(0, cfg.vocab_size,
+                               (n, args.batch_size, seq),
+                               dtype=np.int64).astype(np.int32)
+            return jnp.asarray(toks)
         toks = rng.randint(0, cfg.vocab_size, (batch, seq),
                            dtype=np.int64).astype(np.int32)
         return jax.device_put(jnp.asarray(toks), batch_sharding)

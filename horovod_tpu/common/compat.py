@@ -8,6 +8,8 @@ which caps jax at 0.4.x, where shard_map still lived in
 one import and the modern path free of try/except noise.
 """
 
+import math
+
 import jax
 
 try:
@@ -21,3 +23,24 @@ except AttributeError:  # jax < 0.6 (numpy<1.24 envs, e.g. real-mxnet)
         if axis_names is not None:
             kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
         return _sm_old(f, mesh, **kw)
+
+    # __graft_entry__ and user scripts call jax.shard_map directly, so the
+    # shim is installed INTO jax (same pattern as lax.axis_size below);
+    # on modern jax the try above binds the real one and this is dead.
+    jax.shard_map = shard_map
+
+
+if not hasattr(jax.lax, "axis_size"):
+    # jax < 0.5 has no lax.axis_size; the trace context carries the bound
+    # axis sizes (core.axis_frame returns the size there). ~20 call sites
+    # across ops/ and parallel/ use ``lax.axis_size``, so the shim is
+    # installed INTO jax.lax (importing this module anywhere in the
+    # package is enough) instead of rewriting every site to a compat
+    # import. Only defined names are touched — on modern jax this block
+    # is dead.
+    def _axis_size(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            return math.prod(jax.core.axis_frame(a) for a in axis_name)
+        return jax.core.axis_frame(axis_name)
+
+    jax.lax.axis_size = _axis_size

@@ -57,6 +57,12 @@ class TransformerConfig:
     # inside shard_map with the 'sp' axis bound (parallel/ring.py); under
     # plain GSPMD jit the full path is used and XLA inserts gathers.
     attention_impl: str = "full"
+    # Forward accumulation variant of the flash kernel ('auto' | 'online'
+    # | 'lazy' | 'twopass' — ops/flash_attention.VARIANTS; only read when
+    # attention_impl routes through the flash kernel). 'auto' applies the
+    # measured heuristic in resolve_variant; HVD_FLASH_VARIANT overrides
+    # either way (the bench ablation hook).
+    flash_variant: str = "auto"
     # Mixture-of-Experts: num_experts > 0 replaces the dense MLP with
     # models/moe.py's expert layer (experts shard over the 'ep' mesh axis).
     num_experts: int = 0
@@ -134,7 +140,8 @@ def _dispatch_attention(cfg, q, k, v, sp):
         # ring_flash with the whole sequence on this worker: the flash
         # kernel IS the single-block ring
         from ..ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               variant=cfg.flash_variant)
     return ring.full_attention(q, k, v, causal=True)
 
 

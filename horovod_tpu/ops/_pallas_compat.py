@@ -1,0 +1,12 @@
+"""Version shims for the Pallas TPU API surface the kernels use.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+depending on the jax wheel in the image exactly one of the two exists.
+Every kernel module imports the name from here so the kernels run on
+both sides of the rename.
+"""
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

@@ -79,7 +79,13 @@ def ensure_varying(x, axis_names):
     reduction."""
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
-    vma = jax.typeof(x).vma
+    try:
+        vma = jax.typeof(x).vma
+    except AttributeError:
+        # jax builds without the varying-manual-axes type system
+        # (jax.typeof/pcast landed together): every shard_map value is
+        # implicitly varying there, so there is nothing to cast
+        return x
     missing = tuple(a for a in axis_names if a not in vma)
     if not missing:
         return x

@@ -29,15 +29,51 @@ the CPU mesh), selected automatically.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import _pallas_compat
+
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
 _LN2 = 0.6931471805599453
+
+#: Forward accumulation variants (the backward kernels are shared — every
+#: variant writes the same natural-log lse residual):
+#:   online  — the classic per-tile rescale chain (r5 kernel)
+#:   lazy    — deferred rescale: running max + un-normalized accumulator,
+#:             the [block_q, d] correction runs only on tiles that raise
+#:             the max (diagonal-first k order so it stabilizes early)
+#:   twopass — pass 1 computes the row max (matmul + rowmax only), pass 2
+#:             re-computes QK^T and accumulates exp2(s−m)@V with NO
+#:             loop-carried correction at all
+VARIANTS = ("online", "lazy", "twopass")
+
+
+def resolve_variant(variant, causal=True, nk=1):
+    """Resolve 'auto' (and the HVD_FLASH_VARIANT env override, which wins
+    over any explicit argument — the bench A/B hook) to a concrete
+    forward variant. The heuristic encodes the ablation in
+    docs/benchmarks.md: lazy whenever the k loop has ≥2 tiles (its gated
+    rescale degrades to exactly the online chain in the worst case and
+    skips the [block_q, d] correction otherwise); online for the 1-tile
+    degenerate loop where there is nothing to defer; twopass stays
+    opt-in — its extra QK^T pass only pays off where the VPU chain
+    dominates the MXU (see the variant × shape table)."""
+    env = os.environ.get("HVD_FLASH_VARIANT", "").strip().lower()
+    if env:
+        variant = env
+    if variant not in VARIANTS + ("auto",):
+        raise ValueError(
+            f"unknown flash variant {variant!r}; expected one of "
+            f"{VARIANTS + ('auto',)}")
+    if variant == "auto":
+        return "lazy" if nk >= 2 else "online"
+    return variant
 
 
 def _auto_interpret():
@@ -62,7 +98,7 @@ def _out_struct(shape, dtype, *like):
 # both grid dims are independent (programs share no state): 'parallel'
 # lets Mosaic software-pipeline across grid steps instead of flushing
 # between them
-_COMPILER_PARAMS = pltpu.CompilerParams(
+_COMPILER_PARAMS = _pallas_compat.CompilerParams(
     dimension_semantics=("parallel", "parallel"))
 
 
@@ -181,8 +217,196 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q, block_k,
         sem_v=pltpu.SemaphoreType.DMA((2,)))
 
 
+def _fwd_kernel_lazy(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q,
+                     block_k, seq_k, causal, scale):
+    """Lazy/deferred-rescale forward (splash-attention style). The online
+    kernel pays the full correction chain — exp2(m−m_new) + a [block_q]
+    and a [block_q, d] multiply-add — on EVERY k tile, even when the
+    running max did not move. Here m/l/acc live in VMEM scratch and the
+    correction is predicated on ``any(tile_max > m)``: tiles that do not
+    raise the row max (the common case once the max has stabilized) run
+    only matmul + rowmax + exp2 + two accumulates. K tiles are walked
+    diagonal-first (descending) so for causal attention the near-diagonal
+    tiles — where the largest logits live for recency-dominated heads —
+    set the max in the first iterations and the remaining tiles take the
+    cheap path. Worst case (max strictly rising every tile) it degrades
+    to exactly the online chain, gated once per tile, never to less
+    numerical care: a skipped rescale means every alpha was exactly 1.
+    Same lse contract as _fwd_kernel (natural log, 8-sublane replicated),
+    so the backward kernels are shared unchanged."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    d = q_ref.shape[-1]
+    q = q_ref[0]                                # [block_q, d]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    scale2 = scale * _LOG2E
+
+    nk_total = seq_k // block_k
+    if causal:
+        nk = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                         nk_total)
+    else:
+        nk = nk_total
+
+    def scoped(k_scr, v_scr, stats_scr, acc_scr, sem_k, sem_v):
+        streams = [_stream(k_hbm, bh, block_k, k_scr, sem_k),
+                   _stream(v_hbm, bh, block_k, v_scr, sem_v)]
+        # diagonal-first: loop step t processes k tile nk-1-t
+        _start_all(streams, 0, nk - 1)
+        stats_scr[0] = jnp.full((block_q,), _NEG_INF, jnp.float32)  # m
+        stats_scr[1] = jnp.zeros((block_q,), jnp.float32)           # l
+        acc_scr[:] = jnp.zeros((block_q, d), jnp.float32)
+
+        def body(t, _):
+            kb = nk - 1 - t
+            slot = t % 2
+
+            @pl.when(t + 1 < nk)
+            def _prefetch():
+                _start_all(streams, (t + 1) % 2, kb - 1)
+
+            _wait_all(streams, slot, kb)
+            k = k_scr[slot]
+            v = v_scr[slot]
+            s = jnp.dot(q, k.T,
+                        preferred_element_type=jnp.float32) * scale2
+            if causal:
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            m_tile = jnp.max(s, axis=-1)
+            m_cur = stats_scr[0]
+
+            @pl.when(jnp.any(m_tile > m_cur))
+            def _rescale():
+                m_new = jnp.maximum(m_cur, m_tile)
+                alpha = jnp.exp2(m_cur - m_new)
+                stats_scr[0] = m_new
+                stats_scr[1] = stats_scr[1] * alpha
+                acc_scr[:] = acc_scr[:] * alpha[:, None]
+
+            p = jnp.exp2(s - stats_scr[0][:, None])
+            stats_scr[1] = stats_scr[1] + jnp.sum(p, axis=-1)
+            acc_scr[:] = acc_scr[:] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            return 0
+
+        jax.lax.fori_loop(0, nk, body, 0)
+        m = stats_scr[0]
+        l = jnp.clip(stats_scr[1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            ((m + jnp.log2(l)) * _LN2)[None, :], (8, m.shape[0]))
+
+    pl.run_scoped(
+        scoped,
+        k_scr=pltpu.VMEM((2, block_k, d), k_hbm.dtype),
+        v_scr=pltpu.VMEM((2, block_k, d), v_hbm.dtype),
+        stats_scr=pltpu.VMEM((2, block_q), jnp.float32),
+        acc_scr=pltpu.VMEM((block_q, d), jnp.float32),
+        sem_k=pltpu.SemaphoreType.DMA((2,)),
+        sem_v=pltpu.SemaphoreType.DMA((2,)))
+
+
+def _fwd_kernel_twopass(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q,
+                        block_k, seq_k, causal, scale):
+    """Two-pass forward: pass 1 streams K and reduces the row max (one
+    matmul + rowmax per tile — no exp, no corrections); pass 2 re-streams
+    K with V, re-computes QK^T against the now-final max, and accumulates
+    l += Σ exp2(s−m) and acc += p@V with ZERO loop-carried correction —
+    the serial m/l/acc-alpha dependency chain of the online form is gone
+    from the hot pass entirely. The price is one extra QK^T matmul per
+    tile (+50% forward MXU work) and K streamed twice (HBM traffic still
+    O(s·d)); the bet is shapes where the VPU softmax chain, not the MXU,
+    is the bottleneck. Numerics: m is exact (not running), so p ≤ 1
+    always; same lse contract, shared backward."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    d = q_ref.shape[-1]
+    q = q_ref[0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    scale2 = scale * _LOG2E
+
+    nk_total = seq_k // block_k
+    if causal:
+        nk = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                         nk_total)
+    else:
+        nk = nk_total
+
+    def logits(k, kb):
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale2
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        return s
+
+    def scoped(k_scr, v_scr, sem_k, sem_v):
+        k_stream = _stream(k_hbm, bh, block_k, k_scr, sem_k)
+        v_stream = _stream(v_hbm, bh, block_k, v_scr, sem_v)
+
+        # ---- pass 1: row max only (K stream alone)
+        k_stream(0, 0).start()
+
+        def max_body(kb, m):
+            slot = kb % 2
+
+            @pl.when(kb + 1 < nk)
+            def _prefetch():
+                k_stream((kb + 1) % 2, kb + 1).start()
+
+            k_stream(slot, kb).wait()
+            return jnp.maximum(m, jnp.max(logits(k_scr[slot], kb),
+                                          axis=-1))
+
+        m = jax.lax.fori_loop(
+            0, nk, max_body, jnp.full((block_q,), _NEG_INF, jnp.float32))
+
+        # ---- pass 2: correction-free accumulation (K and V streams)
+        streams = [k_stream, v_stream]
+        _start_all(streams, 0, 0)
+
+        def acc_body(kb, carry):
+            l, acc = carry
+            slot = kb % 2
+
+            @pl.when(kb + 1 < nk)
+            def _prefetch():
+                _start_all(streams, (kb + 1) % 2, kb + 1)
+
+            _wait_all(streams, slot, kb)
+            v = v_scr[slot]
+            p = jnp.exp2(logits(k_scr[slot], kb) - m[:, None])
+            l = l + jnp.sum(p, axis=-1)
+            acc = acc + jnp.dot(p.astype(v.dtype), v,
+                                preferred_element_type=jnp.float32)
+            return l, acc
+
+        l, acc = jax.lax.fori_loop(
+            0, nk, acc_body, (jnp.zeros((block_q,), jnp.float32),
+                              jnp.zeros((block_q, d), jnp.float32)))
+        l = jnp.clip(l, 1e-30)
+        o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            ((m + jnp.log2(l)) * _LN2)[None, :], (8, m.shape[0]))
+
+    pl.run_scoped(
+        scoped,
+        k_scr=pltpu.VMEM((2, block_k, d), k_hbm.dtype),
+        v_scr=pltpu.VMEM((2, block_k, d), v_hbm.dtype),
+        sem_k=pltpu.SemaphoreType.DMA((2,)),
+        sem_v=pltpu.SemaphoreType.DMA((2,)))
+
+
+_FWD_KERNELS = {"online": _fwd_kernel, "lazy": _fwd_kernel_lazy,
+                "twopass": _fwd_kernel_twopass}
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None,
-               layout="bshd"):
+               layout="bshd", variant="online"):
     if layout == "bhsd":
         # head-major: the flatten to [b*h, s, d] is a free reshape — the
         # caller (e.g. the transformer block, which is in this layout for
@@ -210,7 +434,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, scale=None,
         kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
         vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    kernel = functools.partial(_fwd_kernel, block_q=block_q,
+    kernel = functools.partial(_FWD_KERNELS[variant], block_q=block_q,
                                block_k=block_k, seq_k=sk, causal=causal,
                                scale=scale)
     out, lse = pl.pallas_call(
@@ -486,17 +710,17 @@ def fit_block(block, s):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_core(q, k, v, causal, block_q, block_k, interpret, scale,
-                block_q_dkv, block_k_dkv, layout):
+                block_q_dkv, block_k_dkv, layout, variant):
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
-                        scale=scale, layout=layout)
+                        scale=scale, layout=layout, variant=variant)
     return out
 
 
 def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
                     interpret=None, block_q_dkv=None, block_k_dkv=None,
-                    layout="bshd"):
+                    layout="bshd", variant="auto"):
     """Fused attention; q/k/v [batch, seq, heads, head_dim] (or
     [batch, heads, seq, head_dim] with ``layout="bhsd"`` — the flatten to
     the kernel's physical [batch·heads, seq, head_dim] is then a free
@@ -516,7 +740,14 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
     Other non-divisible cases would need an explicit key mask the kernel
     doesn't carry, so they raise. On real TPU, head_dim is zero-padded to
     the 128-lane tile (softmax scale keeps the true head_dim; zero columns
-    drop out of every dot product)."""
+    drop out of every dot product).
+
+    ``variant`` selects the forward accumulation scheme (VARIANTS:
+    'online' | 'lazy' | 'twopass', or 'auto' — see resolve_variant; the
+    HVD_FLASH_VARIANT env var overrides all of them, which is the bench
+    A/B hook). All variants compute the exact same softmax and write the
+    same lse residual, so the backward kernels are shared and gradients
+    are variant-independent."""
     if layout not in ("bshd", "bhsd"):
         raise ValueError(f"unknown layout {layout!r}")
     seq_axis = 2 if layout == "bhsd" else 1
@@ -542,8 +773,10 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
     if pad_d:
         pads = ((0, 0), (0, 0), (0, 0), (0, pad_d))
         q, k, v = jnp.pad(q, pads), jnp.pad(k, pads), jnp.pad(v, pads)
+    variant = resolve_variant(variant, causal=causal,
+                              nk=(sk + pad_k) // bk)
     out = _flash_core(q, k, v, causal, bq, bk, interpret_eff, scale,
-                      bq2, bk2, layout)
+                      bq2, bk2, layout, variant)
     if pad_d:
         out = out[..., :d]
     if pad_q:
@@ -552,14 +785,14 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret, scale,
-             block_q_dkv, block_k_dkv, layout):
+             block_q_dkv, block_k_dkv, layout, variant):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
-                          scale=scale, layout=layout)
+                          scale=scale, layout=layout, variant=variant)
     return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_k, interpret, scale, block_q_dkv,
-             block_k_dkv, layout, residuals, g):
+             block_k_dkv, layout, variant, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
                       interpret, scale=scale, block_q_dkv=block_q_dkv,

@@ -31,6 +31,7 @@ with a custom MPI struct, parameter_manager.cc:66-81).
 import collections
 import os
 import socketserver
+import struct
 import threading
 import time
 
@@ -135,6 +136,166 @@ def decode_hits(data):
     return ids
 
 
+# --- compact response wire --------------------------------------------------
+#
+# The steady-state hot message is the coordinator's CycleResponse: one per
+# worker per cycle (default every 5 ms x nproc). As a plain pickle each
+# response serialized the class layout of CycleResponse plus every
+# NegotiatedResponse — ~90 bytes of pickle framing/attribute names PER
+# RESPONSE OBJECT before any payload, against a few bytes of actual
+# content (the request path already went compact: encode_hits). The
+# response now pickles via __reduce__ into (decoder, (payload,)) where
+# payload is a versioned struct/varint byte string: integers are varint,
+# strings length-prefixed utf-8, the op an enum nibble, and the whole
+# NegotiatedResponse list flattened inline.
+#
+# Versioning is load-bearing, not decoration: the first payload byte is
+# RESPONSE_WIRE_VERSION and decode_response REFUSES (ValueError naming
+# both versions) anything else, so a coordinator speaking a newer wire
+# fails a mismatched worker loudly at the first cycle instead of letting
+# it misparse fields. Workers from builds predating this encoding fail
+# equally loudly: their unpickle cannot resolve decode_response at all.
+
+RESPONSE_WIRE_VERSION = 1
+
+# op enum for the wire; index 0 is reserved for "op carried as a string"
+# so an op this table doesn't know (a newer build's) still round-trips
+_WIRE_OPS = (ALLREDUCE, ALLGATHER, BROADCAST, REDUCESCATTER, ALLTOALL)
+
+
+def _put_varint(out, n):
+    while True:
+        out.append((n & 0x7F) | (0x80 if n > 0x7F else 0))
+        n >>= 7
+        if not n:
+            break
+
+
+def _get_varint(buf, i):
+    cur = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        cur |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return cur, i
+        shift += 7
+
+
+def _put_str(out, s):
+    """Length-prefixed utf-8; the length is offset by one so 0 can carry
+    None (NegotiatedResponse.error is None on every EXECUTE)."""
+    if s is None:
+        out.append(0)
+        return
+    b = s.encode("utf-8")
+    _put_varint(out, len(b) + 1)
+    out.extend(b)
+
+
+def _get_str(buf, i):
+    n, i = _get_varint(buf, i)
+    if n == 0:
+        return None, i
+    n -= 1
+    return bytes(buf[i:i + n]).decode("utf-8"), i + n
+
+
+def encode_response(resp):
+    """CycleResponse -> versioned compact bytes (see block comment)."""
+    out = bytearray()
+    out.append(RESPONSE_WIRE_VERSION)
+    _put_varint(out, resp.base_seq)
+    out.append((1 if resp.shutdown else 0) | (2 if resp.stale_ack else 0))
+    thr, cyc = resp.params
+    _put_varint(out, int(thr))
+    out.extend(struct.pack("<d", float(cyc)))
+    for ids in (resp.unknown_ids, resp.lost_ranks):
+        _put_varint(out, len(ids))
+        for v in ids:
+            _put_varint(out, int(v))
+    _put_varint(out, len(resp.responses))
+    for r in resp.responses:
+        try:
+            op_i = _WIRE_OPS.index(r.op) + 1
+        except ValueError:
+            op_i = 0
+        # one header byte: bit0 kind, bits1-3 op enum, bit4 cache_ids
+        out.append((1 if r.kind == NegotiatedResponse.EXECUTE else 0)
+                   | (op_i << 1)
+                   | (16 if r.cache_ids is not None else 0))
+        if op_i == 0:
+            _put_str(out, r.op)
+        _put_varint(out, len(r.names))
+        for name in r.names:
+            _put_str(out, name)
+        _put_str(out, r.error)
+        if r.cache_ids is not None:
+            for cid in r.cache_ids:  # parallel to names, same count
+                _put_varint(out, int(cid))
+    return bytes(out)
+
+
+def decode_response(payload):
+    """Versioned compact bytes -> CycleResponse; refuses any version
+    other than RESPONSE_WIRE_VERSION so mismatched builds fail at the
+    first cycle with a diagnosis instead of misparsing the stream."""
+    if not payload:
+        raise ValueError("negotiation: empty CycleResponse payload")
+    got = payload[0]
+    if got != RESPONSE_WIRE_VERSION:
+        raise ValueError(
+            f"negotiation: CycleResponse wire version {got} from the "
+            f"coordinator, this worker speaks {RESPONSE_WIRE_VERSION} — "
+            "coordinator and workers are running mismatched horovod_tpu "
+            "builds; run the same version on every rank")
+    i = 1
+    base_seq, i = _get_varint(payload, i)
+    flags = payload[i]
+    i += 1
+    thr, i = _get_varint(payload, i)
+    cyc = struct.unpack_from("<d", payload, i)[0]
+    i += 8
+    lists = []
+    for _ in range(2):  # unknown_ids, lost_ranks
+        n, i = _get_varint(payload, i)
+        vals = []
+        for _ in range(n):
+            v, i = _get_varint(payload, i)
+            vals.append(v)
+        lists.append(vals)
+    unknown_ids, lost_ranks = lists
+    n_resp, i = _get_varint(payload, i)
+    responses = []
+    for _ in range(n_resp):
+        head = payload[i]
+        i += 1
+        kind = (NegotiatedResponse.EXECUTE if head & 1
+                else NegotiatedResponse.ERROR)
+        op_i = (head >> 1) & 0x7
+        if op_i:
+            op = _WIRE_OPS[op_i - 1]
+        else:
+            op, i = _get_str(payload, i)
+        n_names, i = _get_varint(payload, i)
+        names = []
+        for _ in range(n_names):
+            s, i = _get_str(payload, i)
+            names.append(s)
+        error, i = _get_str(payload, i)
+        cache_ids = None
+        if head & 16:
+            cache_ids = []
+            for _ in range(n_names):
+                cid, i = _get_varint(payload, i)
+                cache_ids.append(cid)
+        responses.append(NegotiatedResponse(kind, op, names, error=error,
+                                            cache_ids=cache_ids))
+    return CycleResponse(base_seq, responses, (thr, cyc), bool(flags & 1),
+                         stale_ack=bool(flags & 2),
+                         unknown_ids=unknown_ids, lost_ranks=lost_ranks)
+
+
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
                  hits=b""):
@@ -193,6 +354,14 @@ class CycleResponse:
         # fail its pending work with RanksLostError naming them — a
         # bounded fail-fast instead of the legacy stall-warning hang
         self.lost_ranks = tuple(lost_ranks)
+
+    def __reduce__(self):
+        # the wire form: the per-cycle hot message pickles as
+        # (decode_response, (compact bytes,)) instead of a class-layout
+        # pickle — see the compact-response-wire block above. Pre-wire
+        # workers fail the unpickle loudly (no decode_response symbol);
+        # future-wire workers fail in decode_response's version check.
+        return (decode_response, (encode_response(self),))
 
 
 def _meta_identical(a, b):

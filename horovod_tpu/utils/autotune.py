@@ -26,6 +26,17 @@ from .. import _native
 
 THRESHOLD_BOUNDS = (0.0, 64.0 * 1024 * 1024)
 CYCLE_BOUNDS_MS = (1.0, 100.0)
+# An adopted cycle_time within this fraction of the TOP of its bound is
+# treated as a boundary artifact, not a tuned value (see Autotuner.freeze):
+# the passive scorer measures bytes/us between flushes, and once the cycle
+# timer is longer than the workload's natural burst spacing every flush is
+# demand-driven — the knob stops being observable, the score goes flat in
+# cycle_ms, and the GP's argmax parks on the boundary (r5 adopted 99.22 ms
+# exactly this way). A near-100 ms cycle is also an actively bad value to
+# RUN AT: any tensor that misses a demand flush waits out the full timer.
+# The LOW bound has no such failure mode (short cycles are merely eager),
+# so only the top is clamped.
+CYCLE_BOUNDARY_FRAC = 0.05
 # samples per parameter point before scoring (reference: 5 samples of 10
 # cycles each, parameter_manager.h)
 CYCLES_PER_SAMPLE = 10
@@ -105,6 +116,10 @@ class Autotuner:
     def __init__(self, config, log_path=None, seed=0):
         self.threshold = float(config.fusion_threshold)
         self.cycle_time_ms = float(config.cycle_time_ms)
+        # freeze() falls back to this when the tuned cycle is a boundary
+        # artifact (CYCLE_BOUNDARY_FRAC above)
+        self._default_cycle_ms = float(config.cycle_time_ms)
+        self.cycle_boundary_clamped = False
         self.frozen = False
         if _native.available():
             self._engine = _NativeEngine(seed)
@@ -163,11 +178,23 @@ class Autotuner:
         best values). After this, record_cycle becomes a no-op — the
         coordinator stops paying the per-cycle device sync that exact
         scoring requires. Returns (threshold, cycle_ms, score) or None
-        if nothing was ever scored."""
+        if nothing was ever scored.
+
+        Boundary guard: a best cycle_time within CYCLE_BOUNDARY_FRAC of
+        the top bound is NOT adopted — the threshold is kept but the
+        cycle falls back to the pre-tune default, and
+        ``cycle_boundary_clamped`` is set so callers (bench.py) can
+        report the clamp instead of silently running a flat-score
+        argmax."""
         self.frozen = True
         b = self._engine.best()
         if b is not None:
-            self.threshold, self.cycle_time_ms = b[0], b[1]
+            cycle = b[1]
+            span = CYCLE_BOUNDS_MS[1] - CYCLE_BOUNDS_MS[0]
+            if cycle >= CYCLE_BOUNDS_MS[1] - CYCLE_BOUNDARY_FRAC * span:
+                cycle = self._default_cycle_ms
+                self.cycle_boundary_clamped = True
+            self.threshold, self.cycle_time_ms = b[0], cycle
         return b
 
     def close(self):

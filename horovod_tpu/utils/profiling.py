@@ -115,14 +115,99 @@ def summarize_trace(path):
     return TraceSummary(rows)
 
 
+# Op classes for profile_decomposition, first match wins (checked against
+# the lowercased op name AND long_name). The flash kernels are matched by
+# their Pallas kernel function names (the custom-call carries them);
+# matmul/collective/copy classes follow XLA's HLO naming. Everything that
+# matches nothing lands in "other" — the decomposition never drops time.
+_OP_CLASSES = (
+    ("flash_fwd", ("fwd_kernel",)),
+    ("flash_dq", ("dq_kernel",)),
+    ("flash_dkv", ("dkv_kernel",)),
+    ("collective", ("all-reduce", "allreduce", "all-gather", "allgather",
+                    "reduce-scatter", "all-to-all", "collective",
+                    "psum", "ppermute")),
+    ("matmul", ("dot", "conv", "gemm", "matmul", "einsum")),
+    ("copy", ("copy", "transpose", "bitcast", "memset", "dynamic-slice",
+              "dynamic-update", "pad", "reshape", "concatenate", "slice")),
+    ("fusion", ("fusion", "loop_", "input_", "output_")),
+)
+
+
+def classify_op(row, classes=_OP_CLASSES):
+    hay = (row.name + " " + (row.long_name or "")).lower()
+    for cls, needles in classes:
+        if any(n in hay for n in needles):
+            return cls
+    return "other"
+
+
+def profile_decomposition(trace, wall_ms=None, steps=1,
+                          classes=_OP_CLASSES, top_per_class=3):
+    """Account for every millisecond of a step: group a capture's
+    device-op time into op classes (flash kernels, matmuls, collectives,
+    copies, fusions, other) and, when the wall time of the traced region
+    is known, report the residual — wall minus device-busy, i.e. host
+    dispatch + inter-op gaps, the part no per-op row can show.
+
+    ``trace`` is a profiler dir / trace file / TraceSummary; ``wall_ms``
+    the traced region's wall-clock PER STEP; ``steps`` how many steps the
+    capture spans (all ms are divided by it, so the output reads in
+    ms/step). Composes with merged_timeline.capture(profiler_dir=...):
+    the same user-supplied dir feeds merge() (the visual, host + device
+    on one clock) and this function (the arithmetic). Returns a plain
+    dict — bench.py embeds it verbatim in its JSON line.
+    """
+    summary = trace if isinstance(trace, TraceSummary) else \
+        summarize_trace(trace)
+    buckets = {}
+    for row in summary.rows:
+        buckets.setdefault(classify_op(row, classes), []).append(row)
+    device_ms = summary.total_ms / steps
+    per_class = []
+    for cls, rows in sorted(buckets.items(),
+                            key=lambda kv: -sum(r.total_ms for r in kv[1])):
+        ms = sum(r.total_ms for r in rows) / steps
+        per_class.append({
+            "class": cls,
+            "ms_per_step": round(ms, 3),
+            "pct_of_device": round(100 * ms / device_ms, 1)
+            if device_ms else 0.0,
+            "top_ops": [
+                {"name": r.name, "ms_per_step": round(r.total_ms / steps, 3),
+                 "count": r.count}
+                for r in sorted(rows, key=lambda r: -r.total_ms)
+                [:top_per_class]],
+        })
+    out = {"device_ms_per_step": round(device_ms, 3),
+           "classes": per_class, "steps": steps}
+    if wall_ms is not None:
+        out["wall_ms_per_step"] = round(wall_ms, 3)
+        out["residual_ms_per_step"] = round(wall_ms - device_ms, 3)
+        out["device_busy_frac"] = round(device_ms / wall_ms, 4) \
+            if wall_ms else None
+    return out
+
+
 def main(argv=None):
     import argparse
     p = argparse.ArgumentParser(
         description="Summarize device-op time from a jax.profiler trace")
     p.add_argument("path", help="profiler output dir or trace file")
     p.add_argument("-n", type=int, default=20, help="rows to print")
+    p.add_argument("--decompose", action="store_true",
+                   help="print the op-class decomposition instead")
+    p.add_argument("--wall-ms", type=float, default=None,
+                   help="wall ms/step of the traced region (residual row)")
+    p.add_argument("--steps", type=int, default=1,
+                   help="steps the capture spans (output is per step)")
     args = p.parse_args(argv)
     summary = summarize_trace(args.path)
+    if args.decompose:
+        dec = profile_decomposition(summary, wall_ms=args.wall_ms,
+                                    steps=args.steps)
+        print(json.dumps(dec, indent=2))
+        return
     print(f"device-op total: {summary.total_ms:.1f} ms "
           f"({len(summary.rows)} distinct ops)")
     print("-- by group")

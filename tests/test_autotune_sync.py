@@ -242,6 +242,34 @@ class TestFreeze:
             assert tuner.record_cycle(1 << 20, 0.001) is False
         assert tuner.threshold == 8 << 20
 
+    def test_freeze_clamps_boundary_cycle(self, hvd):
+        """A best point parked at the top of CYCLE_BOUNDS_MS is a
+        flat-score artifact of passive scoring (r5 adopted 99.22 ms this
+        way), not a tuned value: freeze keeps the threshold but falls
+        back to the pre-tune default cycle and says so."""
+        from horovod_tpu.common.config import HorovodConfig
+        from horovod_tpu.utils import autotune as at
+
+        cfg = HorovodConfig.from_env()
+        default_cycle = float(cfg.cycle_time_ms)
+        tuner = at.Autotuner(cfg, seed=4)
+        tuner._engine.record(8 << 20, 99.22, 50.0)  # the r5 adoption
+        best = tuner.freeze()
+        assert best is not None and best[1] == 99.22
+        assert tuner.threshold == 8 << 20          # threshold kept
+        assert tuner.cycle_time_ms == default_cycle
+        assert tuner.cycle_boundary_clamped is True
+
+        # interior points are untouched (and the flag stays down)
+        tuner2 = at.Autotuner(cfg, seed=5)
+        upper = (at.CYCLE_BOUNDS_MS[1]
+                 - 2 * at.CYCLE_BOUNDARY_FRAC
+                 * (at.CYCLE_BOUNDS_MS[1] - at.CYCLE_BOUNDS_MS[0]))
+        tuner2._engine.record(4 << 20, upper, 50.0)
+        tuner2.freeze()
+        assert tuner2.cycle_time_ms == upper
+        assert tuner2.cycle_boundary_clamped is False
+
     def test_coordinator_freeze_applies_config(self, hvd):
         import horovod_tpu
         from horovod_tpu.utils import autotune as at

@@ -128,6 +128,88 @@ class TestCoordinatorUnit:
             svc.shutdown()
 
 
+class TestResponseWire:
+    """Compact CycleResponse encoding (the per-cycle hot message pickles
+    via __reduce__ into versioned struct/varint bytes instead of a
+    class-layout pickle; the request path's encode_hits went compact
+    first)."""
+
+    def _full_response(self, neg):
+        responses = [
+            neg.NegotiatedResponse(
+                neg.NegotiatedResponse.EXECUTE, "allreduce",
+                ["g0", "g1", "g2"], cache_ids=[0, 1, 7]),
+            neg.NegotiatedResponse(
+                neg.NegotiatedResponse.ERROR, "broadcast", ["bad"],
+                error="Mismatched broadcast 'bad' across processes"),
+            neg.NegotiatedResponse(
+                neg.NegotiatedResponse.EXECUTE, "allgather", ["ag"]),
+        ]
+        return neg.CycleResponse(
+            base_seq=42, responses=responses, params=(64 << 20, 5.0),
+            shutdown=False, stale_ack=True, unknown_ids=(5, 9),
+            lost_ranks=(3,))
+
+    def _assert_equal(self, a, b):
+        assert b.base_seq == a.base_seq
+        assert b.params == a.params
+        assert b.shutdown == a.shutdown
+        assert b.stale_ack == a.stale_ack
+        assert b.unknown_ids == a.unknown_ids
+        assert b.lost_ranks == a.lost_ranks
+        assert len(b.responses) == len(a.responses)
+        for ra, rb in zip(a.responses, b.responses):
+            assert (rb.kind, rb.op, rb.names, rb.error, rb.cache_ids) == \
+                (ra.kind, ra.op, ra.names, ra.error, ra.cache_ids)
+
+    def test_roundtrip_through_pickle(self):
+        import cloudpickle
+        from horovod_tpu.ops import negotiation as neg
+        resp = self._full_response(neg)
+        out = cloudpickle.loads(cloudpickle.dumps(resp))
+        self._assert_equal(resp, out)
+
+    def test_roundtrip_empty_response(self):
+        import cloudpickle
+        from horovod_tpu.ops import negotiation as neg
+        resp = neg.CycleResponse(0, [], (0, 99.22), True)
+        out = cloudpickle.loads(cloudpickle.dumps(resp))
+        self._assert_equal(resp, out)
+
+    def test_unknown_op_rides_as_string(self):
+        from horovod_tpu.ops import negotiation as neg
+        resp = neg.CycleResponse(1, [neg.NegotiatedResponse(
+            neg.NegotiatedResponse.EXECUTE, "future_op", ["x"])],
+            (1, 2.0), False)
+        out = neg.decode_response(neg.encode_response(resp))
+        assert out.responses[0].op == "future_op"
+
+    def test_version_mismatch_fails_loudly(self):
+        from horovod_tpu.ops import negotiation as neg
+        payload = bytearray(neg.encode_response(
+            self._full_response(neg)))
+        payload[0] = neg.RESPONSE_WIRE_VERSION + 1
+        with pytest.raises(ValueError, match="wire version"):
+            neg.decode_response(bytes(payload))
+        with pytest.raises(ValueError):
+            neg.decode_response(b"")
+
+    def test_compact_beats_legacy_pickle(self):
+        """The point of the encoding: the steady-state message must be
+        much smaller than a class-layout pickle of the same content."""
+        import pickle
+        from horovod_tpu.ops import negotiation as neg
+        resp = self._full_response(neg)
+        legacy = pickle.dumps(  # what the old wire effectively carried
+            {"base_seq": resp.base_seq, "params": resp.params,
+             "shutdown": resp.shutdown, "stale_ack": resp.stale_ack,
+             "unknown_ids": resp.unknown_ids,
+             "lost_ranks": resp.lost_ranks,
+             "responses": [(r.kind, r.op, r.names, r.error, r.cache_ids)
+                           for r in resp.responses]})
+        assert len(neg.encode_response(resp)) < len(legacy) / 2
+
+
 class TestAnyOrderSubmission:
     def test_ranks_submit_in_opposite_order(self):
         """The capability negotiation exists for (reference

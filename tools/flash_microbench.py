@@ -73,6 +73,11 @@ def main():
     ap.add_argument("--trials", type=int, default=4)
     ap.add_argument("--block-q", type=int, default=512)
     ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--variant", default="auto",
+                    help="forward variant: auto/online/lazy/twopass, or "
+                         "'all' to time every variant back to back "
+                         "in-process (the only trustworthy comparison "
+                         "through the tunnel)")
     ap.add_argument("--skip-xla", action="store_true")
     ap.add_argument("--sweep", action="store_true",
                     help="repeat measurements in-process (cross-process "
@@ -98,30 +103,50 @@ def main():
     interp = jax.default_backend() != "tpu"
     scale = d ** -0.5
 
-    flash = functools.partial(fa.flash_attention, causal=True,
-                              block_q=args.block_q, block_k=args.block_k)
+    def make_loops(variant):
+        flash = functools.partial(fa.flash_attention, causal=True,
+                                  block_q=args.block_q,
+                                  block_k=args.block_k, variant=variant)
 
-    # ---- fwd: chain q <- flash(q, k, v) (same shape, true dependency)
-    def fwd_loop(n):
-        @jax.jit
-        def run(q, k, v):
-            return jax.lax.fori_loop(
-                0, n, lambda i, qq: flash(qq, k, v), q)
-        return run
+        # fwd: chain q <- flash(q, k, v) (same shape, true dependency)
+        def fwd_loop(n):
+            @jax.jit
+            def run(q, k, v):
+                return jax.lax.fori_loop(
+                    0, n, lambda i, qq: flash(qq, k, v), q)
+            return run
 
-    # ---- fwd+bwd: chain q <- q - 1e-3 * (dq + dk + dv)
-    gradfn = jax.grad(lambda *a: jnp.sum(flash(*a).astype(jnp.float32)),
-                      argnums=(0, 1, 2))
+        # fwd+bwd: chain q <- q - 1e-3 * (dq + dk + dv)
+        gradfn = jax.grad(
+            lambda *a: jnp.sum(flash(*a).astype(jnp.float32)),
+            argnums=(0, 1, 2))
 
-    def grad_loop(n):
-        @jax.jit
-        def run(q, k, v):
-            def body(i, qq):
-                # consume ALL grads or XLA DCEs the dkv kernel entirely
-                dq, dk, dv = gradfn(qq, k, v)
-                return qq - (1e-3 * (dq + dk + dv)).astype(qq.dtype)
-            return jax.lax.fori_loop(0, n, body, q)
-        return run
+        def grad_loop(n):
+            @jax.jit
+            def run(q, k, v):
+                def body(i, qq):
+                    # consume ALL grads or XLA DCEs the dkv kernel
+                    dq, dk, dv = gradfn(qq, k, v)
+                    return qq - (1e-3 * (dq + dk + dv)).astype(qq.dtype)
+                return jax.lax.fori_loop(0, n, body, q)
+            return run
+
+        return fwd_loop, grad_loop
+
+    if args.variant == "all":
+        # interleaved variant sweep: every forward variant timed back to
+        # back per round, so cross-process tunnel drift is common-mode
+        for rep in range(2):
+            for var in fa.VARIANTS:
+                vf, vg = make_loops(var)
+                bench_chained(vf, (q, k, v), args.n1, args.n2,
+                              args.trials, f"fwd {var} r{rep}", fwd_flops)
+                bench_chained(vg, (q, k, v), args.n1, args.n2,
+                              args.trials, f"f+b {var} r{rep}",
+                              fwd_flops * 2 + bwd_flops)
+        return
+
+    fwd_loop, grad_loop = make_loops(args.variant)
 
     if args.sweep:
         # repeated in-process measurements (cross-process runs of this
