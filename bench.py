@@ -570,6 +570,16 @@ def main():
             print(f"flash ablation failed: {e}", file=sys.stderr)
             flash_ablation = {"error": str(e)[:200]}
 
+    # Telemetry leg: the final metrics snapshot rides the bench JSON so
+    # BENCH_* artifacts carry control-plane counters (cycle counts,
+    # cache hit rates, fused bytes) across PRs — a regression in those
+    # is visible in the same diff as the headline throughput number.
+    try:
+        from horovod_tpu.utils import metrics as hvd_metrics
+        metrics_snap = hvd_metrics.get_registry().snapshot(max_events=16)
+    except Exception as e:  # noqa: BLE001 — headline still prints
+        metrics_snap = {"error": str(e)[:200]}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_sec_per_chip, 2),
@@ -581,6 +591,7 @@ def main():
         "autotune": autotune,
         "flash_ablation": flash_ablation,
         "profile": profile,
+        "metrics": metrics_snap,
     }))
     return 0
 

@@ -19,6 +19,15 @@ echo "--- kernel numerics (fast fail: flash variants vs reference softmax)"
 python -m pytest tests/test_flash_variants.py tests/test_flash_attention.py \
     -q -m "not slow"
 
+echo "--- metrics (fast fail: telemetry registry, aggregation, stall gauges)"
+# The telemetry plane is load-bearing for every other diagnosis this
+# pipeline does (stall gauges, chaos counters, bench snapshots), and its
+# suite is cheap — run it ahead of the subprocess-heavy full suite. The
+# hvd_top selftest round-trips a canned snapshot through the Prometheus
+# renderer/parser with no network.
+python -m pytest tests/test_metrics.py tests/test_stall.py -q -m "not slow"
+python tools/hvd_top.py --selftest
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

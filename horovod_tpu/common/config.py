@@ -89,6 +89,13 @@ class HorovodConfig:
     chaos_spec: str = ""
     chaos_seed: int = 0
     chaos_delay_ms: float = 50.0
+    # Telemetry plane (utils/metrics.py): base port for the per-rank
+    # Prometheus/JSON exposition server (rank r binds metrics_port + r);
+    # 0 disables serving. metrics_interval is the seconds between a
+    # worker's piggybacked snapshot pushes to rank 0 — the staleness
+    # bound of the aggregate view.
+    metrics_port: int = 0
+    metrics_interval: float = 5.0
     # Autotuning of fusion_threshold / cycle_time.
     autotune: bool = False
     autotune_log: str = ""
@@ -127,6 +134,8 @@ class HorovodConfig:
             chaos_spec=env_str("CHAOS_SPEC", "") or "",
             chaos_seed=env_int("CHAOS_SEED", 0),
             chaos_delay_ms=env_float("CHAOS_DELAY_MS", 50.0),
+            metrics_port=env_int("METRICS_PORT", 0),
+            metrics_interval=env_float("METRICS_INTERVAL", 5.0),
             autotune=env_bool("AUTOTUNE", False),
             autotune_log=env_str("AUTOTUNE_LOG", "") or "",
             autotune_sync_collectives=env_int("AUTOTUNE_SYNC_COLLECTIVES",

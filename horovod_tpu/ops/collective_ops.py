@@ -25,9 +25,11 @@ Reference op → TPU mapping (SURVEY.md §2.2):
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..common import state as state_mod
+from ..utils import metrics as hvd_metrics
 from .compression import Compression
 
 # Reduction op names, parity with horovod's average flag plus explicit ops.
@@ -100,6 +102,31 @@ def in_traced_context(axis_name=None):
 # Traced (in-jit) collectives — the SPMD hot path.
 # ---------------------------------------------------------------------------
 
+def _count_traced(op, tensors):
+    """Trace-time accounting. Runtime counters inside jit are impossible
+    (no host side effects in compiled code), but every (re)trace passes
+    through here — so these counters surface per-op-class traffic shape
+    and, when they keep climbing in steady state, retrace churn."""
+    reg = hvd_metrics.get_registry()
+    if not reg.enabled:
+        return
+    nbytes = 0
+    for t in tensors:
+        try:
+            nbytes += int(np.prod(t.shape)) * np.dtype(t.dtype).itemsize
+        except Exception:  # noqa: BLE001 — abstract values without shape
+            pass
+    reg.counter(
+        "hvd_traced_collective_tensors_total",
+        "Tensors passed through traced (jit-path) collectives, counted "
+        "at trace time, by op class.", labels=("op",)).labels(
+        op=op).inc(len(tensors))
+    reg.counter(
+        "hvd_traced_collective_bytes_total",
+        "Bytes passed through traced (jit-path) collectives, counted "
+        "at trace time, by op class.", labels=("op",)).labels(
+        op=op).inc(nbytes)
+
 def allreduce_traced(tensor, average=True, axis_name=None, op=None,
                      compression=Compression.none):
     """Allreduce inside shard_map/pmap-traced code.
@@ -110,6 +137,7 @@ def allreduce_traced(tensor, average=True, axis_name=None, op=None,
     """
     axis = resolve_axis(axis_name)
     assert axis is not None, "allreduce_traced requires a bound mesh axis"
+    _count_traced("allreduce", [tensor])
     op = op or (AVERAGE if average else SUM)
     compressed, ctx = compression.compress(tensor)
     if op in (SUM, AVERAGE):
@@ -150,6 +178,7 @@ def grouped_allreduce_traced(tensors, average=True, axis_name=None,
         fusion_threshold = state_mod.global_state().config.fusion_threshold \
             if state_mod.is_initialized() else 64 * 1024 * 1024
     leaves, treedef = jax.tree_util.tree_flatten(tensors)
+    _count_traced("grouped_allreduce", leaves)
     compressed = []
     ctxs = []
     for leaf in leaves:
@@ -174,6 +203,7 @@ def allgather_traced(tensor, axis_name=None):
     mpi_operations.cc:86-173; output allocation collective_operations.cc:68)."""
     axis = resolve_axis(axis_name)
     assert axis is not None
+    _count_traced("allgather", [tensor])
     return lax.all_gather(tensor, axis, tiled=True)
 
 
@@ -183,6 +213,7 @@ def broadcast_traced(tensor, root_rank=0, axis_name=None):
     lowers to an efficient one-to-all over ICI."""
     axis = resolve_axis(axis_name)
     assert axis is not None
+    _count_traced("broadcast", [tensor])
     axis_size = lax.axis_size(axis)
     if isinstance(root_rank, int) and not 0 <= root_rank < axis_size:
         raise ValueError(
@@ -198,6 +229,7 @@ def reducescatter_traced(tensor, axis_name=None, average=False):
     of the reference's hierarchical path, nccl_operations.cc:269)."""
     axis = resolve_axis(axis_name)
     assert axis is not None
+    _count_traced("reducescatter", [tensor])
     out = lax.psum_scatter(tensor, axis, tiled=True)
     if average:
         out = out / lax.axis_size(axis)
@@ -210,5 +242,6 @@ def alltoall_traced(tensor, axis_name=None, split_axis=0, concat_axis=0):
     SURVEY.md §5)."""
     axis = resolve_axis(axis_name)
     assert axis is not None
+    _count_traced("alltoall", [tensor])
     return lax.all_to_all(tensor, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)

@@ -57,6 +57,7 @@ from ..common import state as state_mod
 from ..common.exceptions import (DuplicateNameError, MismatchError,
                                  RanksLostError, ShutdownError,
                                  StalledError)
+from ..utils import metrics as hvd_metrics
 from ..utils import timeline as timeline_mod
 
 ALLREDUCE = "allreduce"
@@ -151,14 +152,23 @@ class PlanCache:
         self._cache = collections.OrderedDict()
         self.hits = 0
         self.misses = 0
+        reg = hvd_metrics.get_registry()
+        self._m_hits = reg.counter(
+            "hvd_plan_cache_hits_total",
+            "Fusion-plan cache hits (batch signature seen before).")
+        self._m_misses = reg.counter(
+            "hvd_plan_cache_misses_total",
+            "Fusion-plan cache misses (plan computed fresh).")
 
     def get(self, key):
         plan = self._cache.get(key)
         if plan is not None:
             self._cache.move_to_end(key)
             self.hits += 1
+            self._m_hits.inc()
         else:
             self.misses += 1
+            self._m_misses.inc()
         return plan
 
     def put(self, key, plan):
@@ -295,6 +305,59 @@ class EagerCoordinator:
             from ..utils import autotune as autotune_mod
             self.autotuner = autotune_mod.Autotuner(
                 self._config, log_path=self._config.autotune_log or None)
+        # Telemetry plane (utils/metrics.py): instruments bound once here
+        # so the per-cycle cost is an inc/observe, the exposition server
+        # (HVD_METRICS_PORT + rank) runs off the hot path, and the
+        # snapshot piggyback rides the negotiation cycle every
+        # metrics_interval seconds.
+        reg = self._metrics = hvd_metrics.get_registry()
+        if reg.enabled and reg.rank is None:
+            reg.rank = jax.process_index()
+        self._m_neg_cycles = reg.counter(
+            "hvd_negotiation_cycles_total",
+            "Negotiation cycle RPCs completed by this worker.")
+        self._m_neg_cycle_s = reg.histogram(
+            "hvd_negotiation_cycle_seconds",
+            "Latency of one negotiation cycle RPC (request to response, "
+            "excluding response application).")
+        self._m_neg_failures = reg.counter(
+            "hvd_negotiation_cycle_failures_total",
+            "Cycle RPC failures (transient transport errors; backoff "
+            "applies between retries).")
+        self._m_flush_s = reg.histogram(
+            "hvd_flush_seconds",
+            "Duration of one non-negotiated flush (plan + execute).")
+        self._m_flush_tensors = reg.histogram(
+            "hvd_flush_tensors",
+            "Tensors drained per non-negotiated flush.",
+            buckets=hvd_metrics.COUNT_BUCKETS)
+        self._m_coll_bytes = reg.counter(
+            "hvd_collective_bytes_total",
+            "Payload bytes executed through the eager data plane, by "
+            "op class.", labels=("op",))
+        self._m_coll_s = reg.histogram(
+            "hvd_collective_seconds",
+            "Dispatch latency of one eager collective execution "
+            "(async: completion happens on device), by op class.",
+            labels=("op",))
+        self._m_stalled_tensors = reg.gauge(
+            "hvd_stalled_tensors",
+            "Pending tensors on this worker past the stall warning "
+            "deadline (0 = healthy).")
+        self._m_stall_kills = reg.counter(
+            "hvd_stall_kills_total",
+            "Tensors failed by the stall shutdown deadline.")
+        self._metrics_next_push = 0.0
+        self._metrics_server = None
+        if reg.enabled and getattr(self._config, "metrics_port", 0):
+            try:
+                self._metrics_server = hvd_metrics.MetricsServer(
+                    int(self._config.metrics_port) + jax.process_index(),
+                    reg.snapshot,
+                    remote_snapshots_fn=self._remote_metrics_snapshots)
+            except OSError as exc:
+                log.warning("metrics server failed to bind port %s: %s",
+                            self._config.metrics_port, exc)
         self._thread = threading.Thread(
             target=self._background_loop, daemon=True, name="hvd-background")
         self._thread.start()
@@ -435,6 +498,8 @@ class EagerCoordinator:
             self.plan_cache.put(key, plan)
         self._adopted_this_flush = False
         self._execute(batch, plan)
+        self._m_flush_s.observe(time.perf_counter() - t0)
+        self._m_flush_tensors.observe(len(batch))
         if (self.autotuner is not None
                 and not self.autotuner.frozen
                 and not self._autotune_pending_adoption):
@@ -503,6 +568,7 @@ class EagerCoordinator:
     def _execute(self, batch, plan):
         for kind, idxs, average in plan:
             entries = [batch[i] for i in idxs]
+            t0 = time.perf_counter()
             try:
                 if kind == "fused_allreduce":
                     self._exec_fused_stacked_allreduce(entries, average)
@@ -511,6 +577,11 @@ class EagerCoordinator:
                     self._exec_single(entries[0], op, entry_kind)
                 for e in entries:
                     e.status = True
+                op_class = entries[0].op
+                self._m_coll_bytes.labels(op=op_class).inc(
+                    sum(_entry_nbytes(e) for e in entries))
+                self._m_coll_s.labels(op=op_class).observe(
+                    time.perf_counter() - t0)
             except Exception as exc:
                 for e in entries:
                     e.status = exc
@@ -582,13 +653,24 @@ class EagerCoordinator:
                     metas.append(self._meta_of(e, neg))
             self._reannounce.clear()
             self._cycle_req_id += 1
+        # low-rate metrics piggyback: rank 0's registry is already local
+        # to the aggregating server, so only workers push snapshots
+        push = None
+        if self._metrics.enabled and jax.process_index() != 0:
+            now = time.monotonic()
+            if now >= self._metrics_next_push:
+                self._metrics_next_push = now + (
+                    getattr(self._config, "metrics_interval", 5.0) or 5.0)
+                push = self._metrics.snapshot(max_events=32)
         t0 = time.perf_counter()
         try:
             resp = self._negotiator.cycle(metas, self._applied_seq,
                                           req_id=self._cycle_req_id,
-                                          hits=neg.encode_hits(hit_ids))
+                                          hits=neg.encode_hits(hit_ids),
+                                          metrics=push)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = (metas, hit_ids)
+            self._m_neg_failures.inc()
             now = time.monotonic()
             self._cycle_failures += 1
             if self._cycle_fail_since is None:
@@ -623,6 +705,8 @@ class EagerCoordinator:
                 except Exception:  # noqa: BLE001 — plane truly gone
                     pass
             return
+        self._m_neg_cycles.inc()
+        self._m_neg_cycle_s.observe(time.perf_counter() - t0)
         self._unannounced = None
         self._cycle_failures = 0
         self._cycle_fail_since = None
@@ -638,6 +722,13 @@ class EagerCoordinator:
                 self._config.cycle_time_ms = float(
                     self.autotuner.cycle_time_ms)
 
+    def _remote_metrics_snapshots(self):
+        """Rank 0 only: the peers' piggybacked snapshots held by the
+        coordinator service (the MetricsServer's aggregation source)."""
+        neg = self._negotiator
+        svc = getattr(neg, "service", None) if neg is not None else None
+        return dict(svc.metrics_snapshots) if svc is not None else {}
+
     @staticmethod
     def _meta_of(e, neg):
         t = e.tensor
@@ -648,10 +739,15 @@ class EagerCoordinator:
     def _finish_entries(self, entries, exec_fn):
         """Run exec_fn over entries, then complete them (status, table
         removal, event) — the bookkeeping half of _execute."""
+        t0 = time.perf_counter()
         try:
             exec_fn(entries)
             for e in entries:
                 e.status = True
+            op = entries[0].op
+            self._m_coll_bytes.labels(op=op).inc(
+                sum(_entry_nbytes(e) for e in entries))
+            self._m_coll_s.labels(op=op).observe(time.perf_counter() - t0)
         except Exception as exc:  # noqa: BLE001 — status carries it
             for e in entries:
                 e.status = exc
@@ -1290,9 +1386,14 @@ class EagerCoordinator:
         with self._queue_lock:
             pending = list(self._tensor_table.values())
         stalled = [e for e in pending if now - e.enqueue_time > warn]
+        # gauge recomputed every scan, so it clears when laggards arrive
+        self._m_stalled_tensors.set(len(stalled))
         new = [e for e in stalled if e.name not in self._stall_warned]
         if new:
             names = ", ".join(e.name for e in new)
+            self._metrics.event(
+                "stall", tensors=sorted(e.name for e in new),
+                deadline_s=warn)
             log.warning(
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for "
@@ -1301,6 +1402,10 @@ class EagerCoordinator:
         if kill > 0:
             dead = [e for e in pending if now - e.enqueue_time > kill]
             if dead:
+                self._m_stall_kills.inc(len(dead))
+                self._metrics.event(
+                    "stall_kill", tensors=sorted(e.name for e in dead),
+                    deadline_s=kill)
                 exc = StalledError(
                     f"Collectives stalled past shutdown deadline: "
                     f"{', '.join(e.name for e in dead)}")
@@ -1354,6 +1459,9 @@ class EagerCoordinator:
         for e in pending:
             e.status = exc
             e.event.set()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         if self._negotiator is not None:
             self._negotiator.close()
             self._negotiator = None

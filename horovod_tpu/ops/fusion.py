@@ -21,6 +21,8 @@ import dataclasses
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import metrics as hvd_metrics
+
 
 @dataclasses.dataclass
 class Bucket:
@@ -63,6 +65,31 @@ def plan_buckets(leaves, fusion_threshold):
             order.append(b)
         b.indices.append(i)
         b.nbytes += sizes[i]
+    # fusion-buffer utilization telemetry: the fill fraction of each
+    # planned bucket against the live threshold is the signal the
+    # autotuner (and an operator at hvd_top) reads to judge whether the
+    # threshold is sized right — mostly-empty buckets mean latency paid
+    # for no batching; all-full plus many buckets means it is too small
+    reg = hvd_metrics.get_registry()
+    if reg.enabled and order:
+        fill = reg.histogram(
+            "hvd_fusion_fill_ratio",
+            "Planned bucket bytes / fusion threshold (>1 = oversized "
+            "single tensor in its own bucket).",
+            buckets=hvd_metrics.RATIO_BUCKETS)
+        thr = int(fusion_threshold) or 1
+        for b in order:
+            fill.observe(b.nbytes / thr)
+        reg.counter(
+            "hvd_fusion_buckets_total",
+            "Fused buckets planned.").inc(len(order))
+        reg.counter(
+            "hvd_fusion_tensors_total",
+            "Tensors passed through fusion planning.").inc(len(sizes))
+        reg.counter(
+            "hvd_fusion_bytes_total",
+            "Payload bytes passed through fusion planning.").inc(
+            sum(sizes))
     return order
 
 

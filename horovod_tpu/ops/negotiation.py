@@ -38,6 +38,7 @@ import time
 from ..common import hvd_logging as log
 from ..common.exceptions import RanksLostError
 from ..run import network, secret
+from ..utils import metrics as hvd_metrics
 
 # ops (mirrors eager.py's constants; import cycle keeps them local)
 ALLREDUCE = "allreduce"
@@ -233,7 +234,13 @@ def encode_response(resp):
         if r.cache_ids is not None:
             for cid in r.cache_ids:  # parallel to names, same count
                 _put_varint(out, int(cid))
-    return bytes(out)
+    payload = bytes(out)
+    hvd_metrics.get_registry().counter(
+        "hvd_response_wire_bytes_total",
+        "Compact CycleResponse bytes by direction (out=encoded at the "
+        "coordinator, in=decoded at a worker).",
+        labels=("direction",)).labels(direction="out").inc(len(payload))
+    return payload
 
 
 def decode_response(payload):
@@ -249,6 +256,11 @@ def decode_response(payload):
             f"coordinator, this worker speaks {RESPONSE_WIRE_VERSION} — "
             "coordinator and workers are running mismatched horovod_tpu "
             "builds; run the same version on every rank")
+    hvd_metrics.get_registry().counter(
+        "hvd_response_wire_bytes_total",
+        "Compact CycleResponse bytes by direction (out=encoded at the "
+        "coordinator, in=decoded at a worker).",
+        labels=("direction",)).labels(direction="in").inc(len(payload))
     i = 1
     base_seq, i = _get_varint(payload, i)
     flags = payload[i]
@@ -298,11 +310,17 @@ def decode_response(payload):
 
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
-                 hits=b""):
+                 hits=b"", metrics=None):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
         self.shutdown = shutdown
+        # low-rate piggyback: every HVD_METRICS_INTERVAL seconds the
+        # worker attaches its metrics snapshot (utils/metrics.py) here,
+        # making the negotiation cycle the aggregation transport — no
+        # extra connections, no extra message types. None on the other
+        # ~99% of cycles.
+        self.metrics = metrics
         # idempotency token: a retry after a lost response reuses the id,
         # and the coordinator skips re-submitting entries it already
         # recorded (a popped-and-resubmitted name would otherwise create
@@ -428,6 +446,40 @@ class CoordinatorService(network.BasicService):
         self._cache = collections.OrderedDict()  # id -> EntryMeta
         self._cache_id_of = {}                   # name -> id
         self._next_cache_id = 0
+        # telemetry: piggybacked per-rank snapshots (rank -> snapshot
+        # dict) served by rank 0's MetricsServer as the aggregate view,
+        # plus the coordinator-side instruments (bound once here — the
+        # per-cycle cost in _handle is an inc/observe, not a lookup)
+        self.metrics_snapshots = {}
+        reg = self._metrics = hvd_metrics.get_registry()
+        self._m_cycles = reg.counter(
+            "hvd_coordinator_cycles_total",
+            "CycleRequests processed by the rank-0 coordinator.")
+        self._m_tensors_per_cycle = reg.histogram(
+            "hvd_coordinator_tensors_per_cycle",
+            "Tensor announcements (full metas + cache hits) per cycle.",
+            buckets=hvd_metrics.COUNT_BUCKETS)
+        self._m_cache_hits = reg.counter(
+            "hvd_response_cache_hits_total",
+            "Steady-state cache-id resubmissions (one bit on the wire).")
+        self._m_cache_misses = reg.counter(
+            "hvd_response_cache_misses_total",
+            "Full EntryMeta announcements (first submission or "
+            "post-invalidation re-announce).")
+        self._m_cache_unknown = reg.counter(
+            "hvd_response_cache_unknown_ids_total",
+            "Announced hit ids the coordinator no longer holds "
+            "(evicted/invalidated) — each forces a re-announce.")
+        self._m_stalled_ranks = reg.gauge(
+            "hvd_stalled_ranks",
+            "Ranks currently missing from at least one tensor stalled "
+            "past the stall warning deadline (0 = no stall).")
+        self._m_stalled_pending = reg.gauge(
+            "hvd_coordinator_stalled_tensors",
+            "Pending tensors currently past the stall warning deadline.")
+        self._m_lost_ranks = reg.gauge(
+            "hvd_lost_ranks",
+            "Ranks declared LOST by the liveness ledger (terminal).")
         super().__init__(SERVICE_NAME, key)
 
     # bind to one of the agreed candidate ports instead of an ephemeral
@@ -451,6 +503,9 @@ class CoordinatorService(network.BasicService):
             return network.PingResponse(SERVICE_NAME, client_address[0])
         if isinstance(req, CycleRequest):
             with self._lock:
+                self._m_cycles.inc()
+                if req.metrics is not None:
+                    self.metrics_snapshots[req.rank] = req.metrics
                 self._last_seen[req.rank] = time.monotonic()
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
@@ -470,7 +525,8 @@ class CoordinatorService(network.BasicService):
                 if seen is None or seen[0] != req.req_id:
                     unknown = []
                     self._submit(req.rank, req.entries)
-                    for cid in decode_hits(req.hits):
+                    hit_ids = decode_hits(req.hits)
+                    for cid in hit_ids:
                         meta = self._cache.get(cid)
                         if meta is None:
                             unknown.append(cid)
@@ -479,6 +535,15 @@ class CoordinatorService(network.BasicService):
                             self._submit(req.rank, [meta])
                     self._seen_req[req.rank] = (req.req_id,
                                                 tuple(unknown))
+                    self._m_tensors_per_cycle.observe(
+                        len(req.entries) + len(hit_ids))
+                    if req.entries:
+                        self._m_cache_misses.inc(len(req.entries))
+                    if hit_ids:
+                        self._m_cache_hits.inc(
+                            len(hit_ids) - len(unknown))
+                    if unknown:
+                        self._m_cache_unknown.inc(len(unknown))
                 else:
                     unknown = list(seen[1])
                 self._negotiate()
@@ -677,17 +742,33 @@ class CoordinatorService(network.BasicService):
         warn = self._config.stall_warning_time_seconds
         if self._config.stall_check_disable or warn <= 0:
             return
+        # Stall state is first-class telemetry, not just a log line: the
+        # gauges are recomputed every scan (so they CLEAR when the
+        # laggard arrives), and each tensor crossing the deadline emits
+        # one structured event carrying the missing-rank set — the datum
+        # an operator actually pages on.
+        stalled_ranks = set()
+        stalled_tensors = 0
         for name in self._order:
             row = self._table[name]
-            if not row.warned and now - row.first_ts > warn:
+            if now - row.first_ts <= warn:
+                continue
+            missing = sorted(set(range(self._nproc)) -
+                             set(row.metas.keys()))
+            stalled_ranks.update(missing)
+            stalled_tensors += 1
+            if not row.warned:
                 row.warned = True
-                missing = sorted(set(range(self._nproc)) -
-                                 set(row.metas.keys()))
+                self._metrics.event(
+                    "stall", tensor=name, missing_ranks=missing,
+                    waited_s=round(now - row.first_ts, 3))
                 log.warning(
                     "One or more tensors were submitted to be reduced, "
                     "gathered or broadcasted by subset of ranks and are "
                     "waiting for remainder of ranks for more than %ss: "
                     "%s (missing ranks: %s)", warn, name, missing)
+        self._m_stalled_ranks.set(len(stalled_ranks))
+        self._m_stalled_pending.set(stalled_tensors)
 
     def _liveness_scan(self, now):
         """Escalate silence to fail-fast: a rank that heartbeated at
@@ -712,6 +793,10 @@ class CoordinatorService(network.BasicService):
         if not dead:
             return
         self._lost_ranks = set(dead)
+        self._m_lost_ranks.set(len(dead))
+        self._metrics.event(
+            "ranks_lost", ranks=dead, deadline_s=deadline,
+            failed_tensors=len(self._order))
         log.error(
             "negotiation liveness: ranks %s sent no cycle for more than "
             "%ss — declaring them LOST and failing all pending work "
@@ -818,10 +903,11 @@ class NegotiationWorker:
                         f"{addresses} after {start_timeout_s}s") from last
                 time.sleep(0.2)
 
-    def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b""):
+    def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b"",
+              metrics=None):
         return self._client.request(
             CycleRequest(self._rank, entries, ack, shutdown,
-                         req_id=req_id, hits=hits))
+                         req_id=req_id, hits=hits, metrics=metrics))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

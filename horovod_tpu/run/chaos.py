@@ -54,6 +54,7 @@ import zlib
 
 from ..common import hvd_logging as log
 from ..common.config import env_float, env_int, env_str
+from ..utils import metrics as hvd_metrics
 
 FAULTS = ("drop_request", "delay_request", "dup_request",
           "drop_response", "delay_response", "truncate_response", "reset")
@@ -156,6 +157,16 @@ class ChaosInjector:
             if not fnmatch.fnmatch(msg_type_name, rule.message):
                 continue
             if rule.fire():
+                reg = hvd_metrics.get_registry()
+                reg.counter(
+                    "hvd_chaos_injections_total",
+                    "Chaos faults injected into the control-plane "
+                    "transport, by fault kind.",
+                    labels=("fault",)).labels(fault=rule.fault).inc()
+                reg.event("chaos_injection", fault=rule.fault,
+                          service=self._service_name,
+                          message=msg_type_name, rule=rule.text,
+                          count=rule.injected)
                 log.warning("CHAOS: injecting %s on %s/%s (rule %r, #%d)",
                             rule.fault, self._service_name, msg_type_name,
                             rule.text, rule.injected)

@@ -21,6 +21,7 @@ import psutil
 
 from . import chaos as chaos_mod
 from . import secret
+from ..utils import metrics as hvd_metrics
 
 
 class PingRequest:
@@ -412,7 +413,17 @@ class BasicClient:
                     self._close_persistent()
                     if attempt == last:
                         raise
-                    time.sleep(self._backoff_delay(attempt))
+                    delay = self._backoff_delay(attempt)
+                    reg = hvd_metrics.get_registry()
+                    reg.counter(
+                        "hvd_transport_retries_total",
+                        "Silent reconnect-and-resend retries on a dead "
+                        "persistent control-plane socket.").inc()
+                    reg.counter(
+                        "hvd_transport_backoff_seconds_total",
+                        "Total seconds slept in transport retry "
+                        "backoff.").inc(delay)
+                    time.sleep(delay)
                 except BaseException:
                     # unexpected failure (e.g. a genuine HMAC mismatch):
                     # the stream position is undefined — never reuse it

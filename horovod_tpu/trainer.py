@@ -14,6 +14,7 @@ Two idioms, mirroring the two ways the framework exposes collectives:
 """
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +24,49 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .common import compat
 from . import optim
 from .ops.compression import Compression
+from .utils import metrics as hvd_metrics
+
+
+def instrument_step(step_fn, tokens_per_step=None, name="train"):
+    """Wrap a compiled train step with step-path telemetry: an
+    ``hvd_step_seconds`` histogram, an ``hvd_steps_total`` counter and —
+    when ``tokens_per_step`` is given — an ``hvd_tokens_per_second``
+    gauge, all labeled by ``name`` so eval/train loops coexist.
+
+    The wrapper blocks on the step's outputs (``block_until_ready``)
+    before stamping the end time: without the sync, async dispatch would
+    time the enqueue (~µs) instead of the step. That makes it a per-step
+    host sync — fine for the per-step host-loop idiom this wraps
+    (make_gspmd_step, whose callers read the loss every step anyway), wrong
+    inside a scanned multi-step. Disabled metrics make this a plain
+    passthrough of the original function.
+    """
+    reg = hvd_metrics.get_registry()
+    if not reg.enabled:
+        return step_fn
+    step_s = reg.histogram(
+        "hvd_step_seconds", "Wall time of one training step (synced).",
+        labels=("loop",))
+    steps = reg.counter(
+        "hvd_steps_total", "Training steps executed.", labels=("loop",))
+    tps = reg.gauge(
+        "hvd_tokens_per_second",
+        "Throughput of the most recent step (tokens_per_step / step "
+        "seconds).", labels=("loop",))
+
+    @functools.wraps(step_fn)
+    def wrapped(*args, **kwargs):
+        t0 = time.perf_counter()
+        out = step_fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        step_s.labels(loop=name).observe(dt)
+        steps.labels(loop=name).inc()
+        if tokens_per_step and dt > 0:
+            tps.labels(loop=name).set(tokens_per_step / dt)
+        return out
+
+    return wrapped
 
 
 def softmax_cross_entropy(logits, labels, weights=None):

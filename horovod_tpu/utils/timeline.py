@@ -49,12 +49,17 @@ class Timeline:
         self._next_pid = 1
         self._lock = threading.Lock()
         self._healthy = True
-        self._start = time.monotonic()
-        # wall-clock epoch at ts=0, sampled at the same instant as the
-        # monotonic base: merged_timeline uses it to place these host
-        # spans on the same absolute clock as a jax.profiler device
-        # trace (whose xplane carries profile_start_time in epoch ns)
-        epoch_us_at_ts0 = time.time_ns() // 1000
+        # The process-wide shared clock (utils/metrics.py): trace ts and
+        # metric/event ts_us ride the same monotonic base, and the
+        # epoch anchor below was sampled at the same instant as that
+        # base — merged_timeline uses it to place these host spans on
+        # the same absolute clock as a jax.profiler device trace (whose
+        # xplane carries profile_start_time in epoch ns), and metrics
+        # snapshots correlate with both through the identical anchor.
+        from . import metrics as metrics_mod
+        clock = metrics_mod.shared_clock()
+        self._start = clock.base
+        epoch_us_at_ts0 = clock.epoch_us_at_ts0
         self._file = open(filename, "w")
         self._file.write("[\n")
         self._thread = threading.Thread(target=self._writer_loop, daemon=True,

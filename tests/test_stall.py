@@ -5,23 +5,28 @@ shutdown). Single process here, so a "stall" is an enqueued collective
 whose flush is held back — the detection deadlines, the warning text and
 the StalledError/ShutdownError surfaces are what's under test."""
 
-import logging
 import time
 
 import numpy as np
 import pytest
 
+from horovod_tpu.utils import metrics as hvd_metrics
+
 
 @pytest.fixture
 def hvd_stall(monkeypatch):
     """Initialized with tiny stall deadlines via the reference's env knobs
-    (operations.cc:998-1002)."""
+    (operations.cc:998-1002). The metrics registry is reset first so the
+    coordinator binds its stall instruments to a fresh one — stall state
+    is asserted through the telemetry plane, not log text."""
     monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.15")
     monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.8")
+    hvd_metrics.reset(enabled=True)
     import horovod_tpu as hvd_mod
     hvd_mod.init()
     yield hvd_mod
     hvd_mod.shutdown()
+    hvd_metrics.reset()
 
 
 def _coord():
@@ -29,47 +34,43 @@ def _coord():
     return horovod_tpu.common.state.global_state().coordinator
 
 
-@pytest.fixture
-def hvd_log(caplog):
-    """The package logger does not propagate to root (it mirrors the
-    reference's standalone C++ logger), so caplog's root handler must be
-    attached to it directly."""
-    from horovod_tpu.common import hvd_logging
-    logger = hvd_logging.get_logger()
-    logger.addHandler(caplog.handler)
-    yield caplog
-    logger.removeHandler(caplog.handler)
-
-
 class TestStall:
-    def test_warning_after_check_time(self, hvd_stall, hvd_log):
+    def test_stall_sets_gauge_and_event_after_check_time(self, hvd_stall):
+        """Stall detection is first-class telemetry: the scan sets the
+        ``hvd_stalled_tensors`` gauge and emits one structured "stall"
+        event naming the tensors — the metric is the contract, the log
+        line is a courtesy."""
+        reg = hvd_metrics.get_registry()
         coord = _coord()
         coord._paused = True  # hold the flush: the collective stalls
         try:
             h = hvd_stall.allreduce_async(np.ones((8, 2)), name="slow")
             time.sleep(0.3)
-            with hvd_log.at_level(logging.WARNING):
-                coord._check_stalled()
-            assert any("waiting for" in r.getMessage()
-                       and "slow" in r.getMessage()
-                       for r in hvd_log.records), hvd_log.records
-            # warned, not killed: releasing the flush completes it
+            coord._check_stalled()
+            assert reg.gauge("hvd_stalled_tensors").value == 1
+            events = [e for e in reg.events() if e["event"] == "stall"]
+            assert events and "slow" in events[-1]["tensors"], events
+            # warned, not killed: releasing the flush completes it, and
+            # the next scan CLEARS the gauge — stall state is current
             coord._paused = False
             out = hvd_stall.synchronize(h)
             np.testing.assert_allclose(np.asarray(out), np.ones((8, 2)))
+            coord._check_stalled()
+            assert reg.gauge("hvd_stalled_tensors").value == 0
         finally:
             coord._paused = False
 
-    def test_warning_emitted_once_per_tensor(self, hvd_stall, hvd_log):
+    def test_stall_event_emitted_once_per_tensor(self, hvd_stall):
+        reg = hvd_metrics.get_registry()
         coord = _coord()
         coord._paused = True
         try:
             h = hvd_stall.allreduce_async(np.ones((8, 1)), name="once")
             time.sleep(0.3)
-            with hvd_log.at_level(logging.WARNING):
-                coord._check_stalled()
-                coord._check_stalled()
-            hits = [r for r in hvd_log.records if "once" in r.getMessage()]
+            coord._check_stalled()
+            coord._check_stalled()
+            hits = [e for e in reg.events() if e["event"] == "stall"
+                    and "once" in e["tensors"]]
             assert len(hits) == 1, hits
             coord._paused = False
             hvd_stall.synchronize(h)
@@ -100,6 +101,11 @@ class TestStall:
             assert "killed" not in coord._tensor_table
             with pytest.raises(hvd_stall.StalledError):
                 hvd_stall.synchronize(h)
+            reg = hvd_metrics.get_registry()
+            assert reg.counter("hvd_stall_kills_total").value == 1
+            (kill,) = [e for e in reg.events()
+                       if e["event"] == "stall_kill"]
+            assert "killed" in kill["tensors"]
         finally:
             coord._paused = False
 

@@ -1,0 +1,383 @@
+"""hvd_top: live terminal dashboard for the telemetry plane.
+
+Polls a horovod_tpu metrics endpoint (utils/metrics.py MetricsServer —
+the JSON snapshot at ``/metrics.json`` or the Prometheus text at
+``/metrics``) and renders the control-plane vitals an operator watches
+during a run: negotiation cycle rate and latency percentiles, cache hit
+rate, collective bytes/s by op class, fusion fill, transport
+retries/chaos injections, stall and lost-rank state, and the tail of the
+structured event log. Rates are deltas between consecutive polls.
+
+Usage:
+    python tools/hvd_top.py [http://host:port] [--interval 2]
+                            [--once] [--selftest]
+
+Point it at rank 0's endpoint (HVD_METRICS_PORT) for the aggregate view
+of every rank; any other rank's endpoint shows that rank alone.
+``--selftest`` renders one frame from a canned snapshot and exits —
+the CI smoke test of the whole render path, no server needed.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+try:
+    from horovod_tpu.utils import metrics as hvd_metrics
+except ImportError:  # run straight from a checkout: tools/ is no package
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from horovod_tpu.utils import metrics as hvd_metrics
+
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RED = "\x1b[31m"
+GREEN = "\x1b[32m"
+YELLOW = "\x1b[33m"
+RESET = "\x1b[0m"
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch(base_url, timeout=3.0):
+    """One aggregate snapshot from either endpoint: ``/metrics.json``
+    preferred (carries events + per-rank views), ``/metrics`` text
+    parsed back as the fallback."""
+    base = base_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/metrics.json",
+                                    timeout=timeout) as r:
+            view = json.loads(r.read().decode())
+        return view.get("aggregate", view), view.get("ranks", {})
+    except (urllib.error.URLError, ValueError, OSError):
+        pass
+    with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+        text = r.read().decode()
+    return snapshot_from_prometheus(text), {}
+
+
+def snapshot_from_prometheus(text):
+    """Rebuild a snapshot-shaped dict from Prometheus text so the
+    renderer has one input format."""
+    parsed = hvd_metrics.parse_prometheus(text)
+    metrics = {}
+    for name, entry in parsed.items():
+        kind = entry["type"]
+        out = {"type": kind, "help": "", "labels": [], "values": []}
+        if kind == "histogram":
+            series = {}
+            for labels, value in entry["samples"]:
+                key = tuple(sorted((k, v) for k, v in labels.items()
+                            if k not in ("le", "__series__")))
+                s = series.setdefault(key, {"buckets": [], "sum": 0.0,
+                                            "count": 0})
+                which = labels.get("__series__")
+                if which == "bucket":
+                    s["buckets"].append((labels.get("le", "+Inf"), value))
+                elif which == "sum":
+                    s["sum"] = value
+                elif which == "count":
+                    s["count"] = int(value)
+            for key, s in series.items():
+                bounds, cum = [], []
+                for le, v in s["buckets"]:
+                    if le == "+Inf":
+                        cum.append(v)
+                    else:
+                        bounds.append(float(le))
+                        cum.append(v)
+                counts = [int(c - (cum[i - 1] if i else 0))
+                          for i, c in enumerate(cum)]
+                out.setdefault("buckets", bounds)
+                out["values"].append({"labels": dict(key),
+                                      "counts": counts, "sum": s["sum"],
+                                      "count": s["count"]})
+        else:
+            for labels, value in entry["samples"]:
+                out["values"].append({"labels": dict(labels),
+                                      "value": value})
+        metrics[name] = out
+    return {"metrics": metrics, "events": [], "ranks": []}
+
+
+def _values(snap, name):
+    return snap.get("metrics", {}).get(name, {}).get("values", [])
+
+
+def _total(snap, name, **label_filter):
+    total = 0.0
+    for v in _values(snap, name):
+        if all(v.get("labels", {}).get(k) == val
+               for k, val in label_filter.items()):
+            total += v.get("value", 0.0)
+    return total
+
+
+def _by_label(snap, name, label):
+    out = {}
+    for v in _values(snap, name):
+        key = v.get("labels", {}).get(label, "")
+        out[key] = out.get(key, 0.0) + v.get("value", 0.0)
+    return out
+
+
+def _hist(snap, name):
+    entry = snap.get("metrics", {}).get(name)
+    if not entry or not entry.get("values"):
+        return None
+    bounds = entry.get("buckets", [])
+    counts = [0] * (len(bounds) + 1)
+    total_sum = 0.0
+    total_count = 0
+    for v in entry["values"]:
+        for i, c in enumerate(v.get("counts", ())):
+            if i < len(counts):
+                counts[i] += c
+        total_sum += v.get("sum", 0.0)
+        total_count += v.get("count", 0)
+    return bounds, counts, total_sum, total_count
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}"
+        n /= 1024
+    return f"{n:,.1f}"
+
+
+def _fmt_s(s):
+    if s is None:
+        return "-"
+    if s < 1e-3:
+        return f"{s * 1e6:.0f}µs"
+    if s < 1.0:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+def _rate(cur, prev, name, dt, **label_filter):
+    if prev is None or dt <= 0:
+        return None
+    d = _total(cur, name, **label_filter) - _total(prev, name,
+                                                  **label_filter)
+    return d / dt
+
+
+def _fmt_rate(r, unit=""):
+    return "-" if r is None else f"{r:,.1f}{unit}"
+
+
+def render(snap, ranks_view, prev=None, dt=0.0, color=True):
+    """One frame of the dashboard as a string."""
+    c = (lambda code, s: f"{code}{s}{RESET}") if color else \
+        (lambda code, s: s)
+    lines = []
+    ranks = snap.get("ranks") or sorted(
+        int(r) for r in ranks_view if str(r).isdigit())
+    head = "hvd_top — ranks: " + (
+        ",".join(str(r) for r in ranks) if ranks else "local")
+    lines.append(c(BOLD, head))
+
+    # health strip first: this is what an operator glances at
+    stalled = _total(snap, "hvd_stalled_ranks")
+    stalled_t = (_total(snap, "hvd_stalled_tensors") +
+                 _total(snap, "hvd_coordinator_stalled_tensors"))
+    lost = _total(snap, "hvd_lost_ranks")
+    if lost:
+        lines.append(c(RED, f"  LOST RANKS: {int(lost)}"))
+    if stalled or stalled_t:
+        lines.append(c(YELLOW, f"  STALL: {int(stalled)} rank(s) "
+                               f"missing, {int(stalled_t)} tensor(s) "
+                               f"waiting"))
+    if not lost and not stalled and not stalled_t:
+        lines.append(c(GREEN, "  healthy — no stalls, no lost ranks"))
+
+    # negotiation / control plane
+    cyc = _total(snap, "hvd_coordinator_cycles_total") or \
+        _total(snap, "hvd_negotiation_cycles_total")
+    cyc_rate = (_rate(snap, prev, "hvd_coordinator_cycles_total", dt) or
+                _rate(snap, prev, "hvd_negotiation_cycles_total", dt))
+    h = _hist(snap, "hvd_negotiation_cycle_seconds")
+    p50 = p99 = None
+    if h:
+        bounds, counts, _, _ = h
+        p50 = hvd_metrics.histogram_quantile(bounds, counts, 0.5)
+        p99 = hvd_metrics.histogram_quantile(bounds, counts, 0.99)
+    lines.append(c(BOLD, "  control plane"))
+    lines.append(f"    cycles        {int(cyc):>12,}   "
+                 f"rate {_fmt_rate(cyc_rate, '/s'):>10}   "
+                 f"p50 {_fmt_s(p50):>8}   p99 {_fmt_s(p99):>8}")
+    hits = _total(snap, "hvd_response_cache_hits_total")
+    misses = _total(snap, "hvd_response_cache_misses_total")
+    unknown = _total(snap, "hvd_response_cache_unknown_ids_total")
+    denom = hits + misses
+    hit_pct = f"{100.0 * hits / denom:.1f}%" if denom else "-"
+    lines.append(f"    resp cache    hits {int(hits):>10,}   "
+                 f"misses {int(misses):>8,}   unknown {int(unknown):>6,}"
+                 f"   hit rate {hit_pct:>7}")
+    wire = _by_label(snap, "hvd_response_wire_bytes_total", "direction")
+    fails = _total(snap, "hvd_negotiation_cycle_failures_total")
+    lines.append(f"    wire          out {_fmt_bytes(wire.get('out', 0)):>12}"
+                 f"   in {_fmt_bytes(wire.get('in', 0)):>12}   "
+                 f"cycle failures {int(fails):,}")
+
+    # data plane
+    lines.append(c(BOLD, "  data plane"))
+    coll = _by_label(snap, "hvd_collective_bytes_total", "op")
+    traced = _by_label(snap, "hvd_traced_collective_bytes_total", "op")
+    for op in sorted(set(coll) | set(traced)):
+        rate = _rate(snap, prev, "hvd_collective_bytes_total", dt, op=op)
+        lines.append(f"    {op:<13} eager {_fmt_bytes(coll.get(op, 0)):>12}"
+                     f"   traced {_fmt_bytes(traced.get(op, 0)):>12}   "
+                     f"{_fmt_rate(rate and rate / (1 << 20), ' MiB/s')}")
+    if not coll and not traced:
+        lines.append(c(DIM, "    (no collectives yet)"))
+    fill = _hist(snap, "hvd_fusion_fill_ratio")
+    if fill and fill[3]:
+        bounds, counts, fsum, fcount = fill
+        lines.append(f"    fusion fill   mean {fsum / fcount:>6.2f}   "
+                     f"buckets {int(_total(snap, 'hvd_fusion_buckets_total')):,}"
+                     f"   bytes {_fmt_bytes(_total(snap, 'hvd_fusion_bytes_total'))}")
+
+    # robustness
+    retries = _total(snap, "hvd_transport_retries_total")
+    backoff = _total(snap, "hvd_transport_backoff_seconds_total")
+    chaos = _by_label(snap, "hvd_chaos_injections_total", "fault")
+    lines.append(c(BOLD, "  robustness"))
+    lines.append(f"    transport     retries {int(retries):>8,}   "
+                 f"backoff {_fmt_s(backoff):>8}   "
+                 f"stall kills {int(_total(snap, 'hvd_stall_kills_total')):,}")
+    if chaos:
+        faults = "  ".join(f"{k}={int(v)}" for k, v in sorted(chaos.items()))
+        lines.append(c(YELLOW, f"    chaos         {faults}"))
+
+    # step path
+    sh = _hist(snap, "hvd_step_seconds")
+    if sh and sh[3]:
+        bounds, counts, ssum, scount = sh
+        sp50 = hvd_metrics.histogram_quantile(bounds, counts, 0.5)
+        tps = _total(snap, "hvd_tokens_per_second")
+        lines.append(c(BOLD, "  step path"))
+        lines.append(f"    steps {scount:>8,}   mean {_fmt_s(ssum / scount):>8}"
+                     f"   p50 {_fmt_s(sp50):>8}   tokens/s {tps:,.0f}")
+
+    # event tail
+    events = snap.get("events", [])[-8:]
+    if events:
+        lines.append(c(BOLD, "  recent events"))
+        for ev in events:
+            kind = ev.get("event", "?")
+            code = RED if kind in ("ranks_lost", "stall_kill") else (
+                YELLOW if kind in ("stall", "chaos_injection") else DIM)
+            detail = {k: v for k, v in ev.items()
+                      if k not in ("event", "ts_us", "epoch_us")}
+            lines.append(c(code, f"    [{ev.get('ts_us', 0) / 1e6:>9.3f}s] "
+                                 f"{kind}: {detail}"))
+    return "\n".join(lines)
+
+
+def canned_snapshot():
+    """A synthetic but schema-correct aggregate snapshot for --selftest:
+    every section of the dashboard has data, so one rendered frame
+    exercises the whole formatter."""
+    reg = hvd_metrics.MetricsRegistry(rank=0)
+    reg.counter("hvd_coordinator_cycles_total", "c").inc(12345)
+    reg.counter("hvd_response_cache_hits_total", "c").inc(11800)
+    reg.counter("hvd_response_cache_misses_total", "c").inc(545)
+    reg.counter("hvd_response_cache_unknown_ids_total", "c").inc(3)
+    w = reg.counter("hvd_response_wire_bytes_total", "c",
+                    labels=("direction",))
+    w.labels(direction="out").inc(4_200_000)
+    w.labels(direction="in").inc(4_100_000)
+    h = reg.histogram("hvd_negotiation_cycle_seconds", "h")
+    for v in (0.0008, 0.0011, 0.0009, 0.004, 0.02):
+        for _ in range(40):
+            h.observe(v)
+    cb = reg.counter("hvd_collective_bytes_total", "c", labels=("op",))
+    cb.labels(op="allreduce").inc(3 << 30)
+    cb.labels(op="allgather").inc(200 << 20)
+    fill = reg.histogram("hvd_fusion_fill_ratio", "h",
+                         buckets=hvd_metrics.RATIO_BUCKETS)
+    for v in (0.2, 0.8, 0.95, 1.0):
+        fill.observe(v)
+    reg.counter("hvd_fusion_buckets_total", "c").inc(420)
+    reg.counter("hvd_fusion_bytes_total", "c").inc(3 << 30)
+    reg.counter("hvd_transport_retries_total", "c").inc(2)
+    reg.counter("hvd_transport_backoff_seconds_total", "c").inc(0.31)
+    reg.counter("hvd_chaos_injections_total", "c",
+                labels=("fault",)).labels(fault="drop_response").inc(5)
+    reg.gauge("hvd_stalled_ranks", "g").set(1)
+    reg.gauge("hvd_stalled_tensors", "g").set(2)
+    sh = reg.histogram("hvd_step_seconds", "h", labels=("loop",))
+    for _ in range(100):
+        sh.labels(loop="train").observe(0.085)
+    reg.gauge("hvd_tokens_per_second",
+              "g", labels=("loop",)).labels(loop="train").set(385000)
+    reg.event("stall", tensor="grad/dense_7", missing_ranks=[3],
+              waited_s=61.2)
+    reg.event("chaos_injection", fault="drop_response",
+              service="hvd.negotiation", message="CycleResponse",
+              rule="demo", count=5)
+    snap = reg.snapshot()
+    snap["ranks"] = [0, 1]
+    return snap
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", nargs="?", default="http://127.0.0.1:9400",
+                    help="metrics endpoint base URL (rank 0's "
+                         "HVD_METRICS_PORT for the aggregate view)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--selftest", action="store_true",
+                    help="render one frame from a canned snapshot "
+                         "(no server) and exit 0")
+    args = ap.parse_args(argv)
+    color = not args.no_color and sys.stdout.isatty() or args.selftest
+
+    if args.selftest:
+        snap = canned_snapshot()
+        frame = render(snap, {}, color=False)
+        print(frame)
+        # the round-trip leg: text exposition of the same snapshot must
+        # parse and render too
+        reparsed = snapshot_from_prometheus(
+            hvd_metrics.render_prometheus(snap))
+        render(reparsed, {}, color=False)
+        print("\nselftest ok")
+        return 0
+
+    prev = None
+    prev_t = None
+    while True:
+        try:
+            snap, ranks_view = fetch(args.url)
+        except Exception as exc:  # noqa: BLE001 — endpoint down
+            print(f"hvd_top: cannot reach {args.url}: {exc}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else 0.0
+        frame = render(snap, ranks_view, prev=prev, dt=dt, color=color)
+        if not args.once:
+            sys.stdout.write(CLEAR)
+        print(frame)
+        if args.once:
+            return 0
+        prev, prev_t = snap, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
