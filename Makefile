@@ -4,7 +4,13 @@ PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 CPU_MESH = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test native bench examples ci clean
+.PHONY: lint test native bench examples ci clean
+
+# distributed-correctness static analysis (tools/hvdlint, docs/hvdlint.md);
+# cheapest gate, so it leads the ci chain
+lint:
+	$(PY) -m tools.hvdlint horovod_tpu tools bench.py
+	$(PY) -m tools.hvdlint --check-envdoc
 
 native:
 	$(PY) setup.py build_native
@@ -46,7 +52,7 @@ examples:
 	$(CPU_ENV) $(PY) examples/mxnet_mnist.py --epochs 1 --steps-per-epoch 4
 	$(CPU_MESH) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-ci: native test examples
+ci: lint native test examples
 
 clean:
 	rm -rf build dist *.egg-info /tmp/hvd-ci-imagenet-ckpt \

@@ -405,6 +405,7 @@ def _bench_flash_ablation(on_tpu, peak):
                 blk = fa.fit_block(512, seq)
                 entry["auto_variant"] = fa.resolve_variant(
                     "auto", causal=True, nk=seq // blk)
+            # hvdlint: disable=HVD006(error is recorded in the ablation entry; partial point still reports)
             except Exception as e:  # noqa: BLE001 — keep partial point
                 entry["error"] = str(e)[:200]
             finally:
@@ -577,6 +578,7 @@ def main():
     try:
         from horovod_tpu.utils import metrics as hvd_metrics
         metrics_snap = hvd_metrics.get_registry().snapshot(max_events=16)
+    # hvdlint: disable=HVD006(error rides the metrics field; the headline number still prints)
     except Exception as e:  # noqa: BLE001 — headline still prints
         metrics_snap = {"error": str(e)[:200]}
 

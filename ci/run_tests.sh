@@ -6,6 +6,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "--- hvdlint (fastest gate: distributed-correctness static analysis)"
+# Dependency-free stdlib-ast lint, seconds not minutes, so it runs before
+# anything that compiles or spawns. Catches rank-divergent iteration,
+# lock-order deadlocks, raw clocks, env-registry drift, swallowed
+# exceptions and jit impurity statically (docs/hvdlint.md); then verifies
+# docs/envvars.md still matches ENV_REGISTRY.
+python -m tools.hvdlint horovod_tpu tools bench.py
+python -m tools.hvdlint --check-envdoc
+
 echo "--- build native core"
 python setup.py build_native
 

@@ -146,3 +146,150 @@ class HorovodConfig:
             log_level=env_str("LOG_LEVEL", "WARNING") or "WARNING",
             log_timestamp=env_bool("LOG_TIMESTAMP", False),
         )
+
+
+# ---------------------------------------------------------------------------
+# The environment-variable registry: every HVD_*/HOROVOD_* variable the
+# framework reads, in one place. This MUST stay a pure literal — the
+# hvdlint HVD005 rule and the docs/envvars.md generator parse it with
+# ast.literal_eval (never importing this module, so linting works
+# without jax). Rows are (name, aliased, default, owner, description).
+#
+#   aliased=True: read through the helpers above, which try the
+#   HOROVOD_ spelling then HVD_; `name` is the canonical HOROVOD_ form
+#   and both spellings are accepted. aliased=False: the exact name is
+#   read literally at the owner site.
+#
+# Adding a variable: add a row here, then regenerate the doc with
+#   python -m tools.hvdlint --emit-envdoc
+# (CI runs --check-envdoc and HVD005, so unregistered reads and a stale
+# doc both fail the lint stage.)
+ENV_REGISTRY = (
+    # -- config helpers (common/config.py:from_env) --------------------
+    ("HOROVOD_AUTOTUNE", True, "0", "common/config.py",
+     "Enable the online fusion-parameter autotuner."),
+    ("HOROVOD_AUTOTUNE_LOG", True, None, "common/config.py",
+     "CSV file the autotuner appends sampled points to."),
+    ("HOROVOD_AUTOTUNE_SYNC_COLLECTIVES", True, "32", "common/config.py",
+     "Adopt tuned values every N replicated collectives (keeps ranks "
+     "in lockstep)."),
+    ("HOROVOD_CACHE_CAPACITY", True, "1024", "common/config.py",
+     "Response-cache capacity of the negotiation client."),
+    ("HOROVOD_CHAOS_DELAY_MS", True, "50.0", "common/config.py",
+     "Injected delay for chaos delay_request/delay_response rules."),
+    ("HOROVOD_CHAOS_SEED", True, "0", "common/config.py",
+     "Deterministic seed for chaos-rule sampling."),
+    ("HOROVOD_CHAOS_SPEC", True, None, "common/config.py",
+     "Chaos-plane fault spec (run/chaos.py grammar); unset disables "
+     "injection."),
+    ("HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS", True, "0.0",
+     "common/config.py",
+     "Worker self-terminates after this long without coordinator "
+     "contact (0 disables)."),
+    ("HOROVOD_CYCLE_TIME", True, "5.0", "common/config.py",
+     "Negotiation cycle time in milliseconds."),
+    ("HOROVOD_FUSION_THRESHOLD", True, "67108864", "common/config.py",
+     "Fusion-buffer byte threshold for bucketing collectives."),
+    ("HOROVOD_HIERARCHICAL_ALLGATHER", True, "0", "common/config.py",
+     "Two-level (intra/inter host) allgather."),
+    ("HOROVOD_HIERARCHICAL_ALLREDUCE", True, "0", "common/config.py",
+     "Two-level (ICI reduce-scatter + DCN allreduce) allreduce."),
+    ("HOROVOD_LOG_LEVEL", True, "WARNING", "common/config.py",
+     "Framework log level (TRACE/DEBUG/INFO/WARNING/ERROR/FATAL)."),
+    ("HOROVOD_LOG_TIMESTAMP", True, "0", "common/config.py",
+     "Prefix log lines with timestamps."),
+    ("HOROVOD_METRICS", True, "1", "utils/metrics.py",
+     "Set 0 to replace the metrics registry with no-op instruments."),
+    ("HOROVOD_METRICS_EVENT_LOG", True, None, "utils/metrics.py",
+     "JSONL file the metrics event channel appends to."),
+    ("HOROVOD_METRICS_INTERVAL", True, "5.0", "common/config.py",
+     "Seconds between rank-0 metrics aggregation pulls."),
+    ("HOROVOD_METRICS_PORT", True, "0", "common/config.py",
+     "Rank-0 HTTP port for /metrics and /metrics.json (0 disables)."),
+    ("HOROVOD_RANK_LOST_TIMEOUT_SECONDS", True, "0.0",
+     "common/config.py",
+     "Coordinator declares a silent rank lost after this long "
+     "(0 disables)."),
+    ("HOROVOD_RING_ALLREDUCE", True, "0", "common/config.py",
+     "Use the explicit ppermute ring allreduce backend."),
+    ("HOROVOD_STALL_CHECK_DISABLE", True, "0", "common/config.py",
+     "Disable the coordinator's stalled-rank warnings."),
+    ("HOROVOD_STALL_CHECK_TIME_SECONDS", True, "60.0",
+     "common/config.py",
+     "Warn when an entry waits longer than this for stragglers."),
+    ("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", True, "0.0",
+     "common/config.py",
+     "Escalate a stall to job shutdown after this long (0 disables)."),
+    ("HOROVOD_TIMELINE", True, None, "common/config.py",
+     "Write a Chrome-trace timeline to this file."),
+    ("HOROVOD_TIMELINE_MARK_CYCLES", True, "0", "common/config.py",
+     "Mark negotiation cycles in the timeline."),
+    # -- launcher / rendezvous (exact names) ---------------------------
+    ("HOROVOD_SECRET_KEY", False, None, "run/cli.py",
+     "Base64 HMAC key for the run service; generated per job when "
+     "unset (HVD_SECRET_KEY also accepted)."),
+    ("HVD_SECRET_KEY", False, None, "run/cli.py",
+     "Alternate spelling of HOROVOD_SECRET_KEY checked by hvdrun."),
+    ("HOROVOD_START_TIMEOUT", False, "600", "run/cli.py",
+     "Seconds hvdrun waits for all workers to register."),
+    ("HVD_COORDINATOR_ADDR", False, None, "mpi_ops.py",
+     "host:port of the jax.distributed coordinator (worker 0)."),
+    ("HVD_CONTROL_ADDR", False, None, "ops/negotiation.py",
+     "Pin the negotiation control-plane listener to this host:port."),
+    ("HVD_NUM_PROC", False, None, "mpi_ops.py",
+     "Total worker count; exported by hvdrun, fallback to MPI/PMI "
+     "world size."),
+    ("HVD_PROCESS_ID", False, None, "mpi_ops.py",
+     "This worker's global rank; exported by hvdrun."),
+    ("HVD_LOCAL_RANK", False, None, "common/state.py",
+     "Rank within the host; exported by hvdrun."),
+    ("HVD_LOCAL_SIZE", False, None, "common/state.py",
+     "Workers on this host; exported by hvdrun."),
+    ("HVD_CROSS_RANK", False, None, "run/cli.py",
+     "Host index of this worker; exported by hvdrun."),
+    ("HVD_CROSS_SIZE", False, None, "run/cli.py",
+     "Number of hosts in the job; exported by hvdrun."),
+    ("HVD_HOST_SALT", False, None, "run/hosts.py",
+     "Extra entropy mixed into the per-host identity hash."),
+    ("HVD_RENDEZVOUS_DIR", False, None, "run/mpi.py",
+     "Shared directory for mpirun-mode file rendezvous (default: "
+     "system tmp; must be shared across hosts)."),
+    ("HVD_SPARK_BIND_ADDR", False, None, "spark/__init__.py",
+     "Pin the Spark driver's run-service bind address."),
+    ("_HVD_RUN_SERVICE_ADDRS", False, None, "run/launch.py",
+     "Internal: codec-encoded service addresses hvdrun hands each "
+     "worker."),
+    ("_HVD_SECRET_KEY", False, None, "run/secret.py",
+     "Internal: per-job base64 HMAC key hvdrun exports to workers."),
+    # -- feature gates / integrations (exact names) --------------------
+    ("HVD_DISABLE_NATIVE", False, None, "_native/__init__.py",
+     "Set 1 to skip loading the C++ native plane and use pure "
+     "Python."),
+    ("HVD_PLANE_SHM", False, "1", "_native/src/plane.h",
+     "Set 0 to force TCP between same-host native planes instead of "
+     "shared memory."),
+    ("HVD_FLASH_VARIANT", False, None, "ops/flash_attention.py",
+     "Flash-attention forward variant override (baseline, "
+     "lazy_rescale, two_pass)."),
+    ("HVD_TF_NATIVE", False, "1", "tensorflow/native.py",
+     "Set 0 to disable the TensorFlow native bridge."),
+    ("HVD_TF_NATIVE_ADDR", False, None, "tensorflow/native.py",
+     "host:port rendezvous for the TF native bridge."),
+    ("HVD_TF_NATIVE_TIMEOUT", False, "60", "tensorflow/native.py",
+     "Seconds to wait on the TF native rendezvous."),
+    ("HVD_TORCH_NATIVE", False, "1", "torch/native.py",
+     "Set 0 to disable the PyTorch native bridge."),
+    ("HVD_TORCH_NATIVE_ADDR", False, None, "torch/native.py",
+     "host:port rendezvous for the torch native bridge."),
+    ("HVD_TORCH_NATIVE_TIMEOUT", False, "60", "torch/native.py",
+     "Seconds to wait on torch native rendezvous/collectives."),
+    # -- bench / CI (exact names) --------------------------------------
+    ("HVD_BENCH_BATCH", False, None, "bench.py",
+     "Override the bench global batch size."),
+    ("HVD_BENCH_PROFILE", False, None, "bench.py",
+     "Force per-op profile legs on (1) or off (0) in bench.py."),
+    ("HVD_BENCH_FLASH_ABLATION", False, None, "bench.py",
+     "Force the flash-attention ablation legs on (1) or off (0)."),
+    ("HVD_TEST_WORKERS", False, "auto", "ci/run_tests.sh",
+     "pytest-xdist worker count for the CI suite."),
+)

@@ -112,7 +112,7 @@ def _traced_axis_index():
         from jax._src.core import get_axis_env  # jax>=0.4.31 internal
         axis_env = get_axis_env()
         names = [n for n in axis_env.axis_sizes if isinstance(n, str)]
-    except Exception:
+    except (ImportError, AttributeError):  # private API may move
         names = []
     if not names:
         return None

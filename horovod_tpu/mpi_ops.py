@@ -78,7 +78,7 @@ def init(devices=None, mesh=None, axis_name=state_mod.HVD_AXIS, config=None,
         try:  # pre-initialized by the caller (the pods flow)
             from jax._src import distributed
             return distributed.global_state.coordinator_address is not None
-        except Exception:  # noqa: BLE001 — private API may move
+        except (ImportError, AttributeError):  # private API may move
             return False
 
     if coordinator_address is None and "HVD_COORDINATOR_ADDR" in os.environ:

@@ -45,7 +45,7 @@ def _bound_axis_names():
         from jax._src.core import get_axis_env
         env = get_axis_env()
         return [n for n in env.axis_sizes if isinstance(n, str)]
-    except Exception:
+    except (ImportError, AttributeError):  # private API may move
         return []
 
 
@@ -114,8 +114,8 @@ def _count_traced(op, tensors):
     for t in tensors:
         try:
             nbytes += int(np.prod(t.shape)) * np.dtype(t.dtype).itemsize
-        except Exception:  # noqa: BLE001 — abstract values without shape
-            pass
+        except (TypeError, ValueError, AttributeError):
+            pass  # abstract values without a concrete shape/dtype
     reg.counter(
         "hvd_traced_collective_tensors_total",
         "Tensors passed through traced (jit-path) collectives, counted "

@@ -582,6 +582,7 @@ class EagerCoordinator:
                     sum(_entry_nbytes(e) for e in entries))
                 self._m_coll_s.labels(op=op_class).observe(
                     time.perf_counter() - t0)
+            # hvdlint: disable=HVD006(status carries the fault to every waiter)
             except Exception as exc:
                 for e in entries:
                     e.status = exc
@@ -668,6 +669,7 @@ class EagerCoordinator:
                                           req_id=self._cycle_req_id,
                                           hits=neg.encode_hits(hit_ids),
                                           metrics=push)
+        # hvdlint: disable=HVD006(retried next cycle; counted in hvd_negotiation_failures and escalated by liveness fail-fast)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = (metas, hit_ids)
             self._m_neg_failures.inc()
@@ -702,6 +704,7 @@ class EagerCoordinator:
                     self._negotiator.cycle([], self._applied_seq,
                                            shutdown=True,
                                            req_id=self._cycle_req_id)
+                # hvdlint: disable=HVD006(shutdown farewell; control plane already gone)
                 except Exception:  # noqa: BLE001 — plane truly gone
                     pass
             return
@@ -748,6 +751,7 @@ class EagerCoordinator:
             self._m_coll_bytes.labels(op=op).inc(
                 sum(_entry_nbytes(e) for e in entries))
             self._m_coll_s.labels(op=op).observe(time.perf_counter() - t0)
+        # hvdlint: disable=HVD006(status carries the fault to every waiter)
         except Exception as exc:  # noqa: BLE001 — status carries it
             for e in entries:
                 e.status = exc
@@ -786,6 +790,7 @@ class EagerCoordinator:
                 self._negotiator.cycle([], self._applied_seq,
                                        shutdown=True,
                                        req_id=self._cycle_req_id)
+            # hvdlint: disable=HVD006(shutdown farewell; control plane already gone)
             except Exception:  # noqa: BLE001 — plane gone too
                 pass
             return 0
@@ -1448,6 +1453,7 @@ class EagerCoordinator:
                     # mid-cycle), announcing shutdown above is all that is
                     # safe to do from this thread.
                     self._apply_cycle_response(resp)
+            # hvdlint: disable=HVD006(final drain at shutdown; peer may already be gone)
             except Exception:  # noqa: BLE001 — peer may already be gone
                 pass
         with self._queue_lock:

@@ -23,6 +23,7 @@ class Cache:
         try:
             with open(self._path, "rb") as f:
                 self._store = pickle.load(f)
+        # hvdlint: disable=HVD006(missing or corrupt cache file just means a cold start)
         except Exception:
             self._store = {}
 
@@ -45,5 +46,6 @@ class Cache:
                 with open(tmp, "wb") as f:
                     pickle.dump(self._store, f)
                 os.replace(tmp, self._path)
+            # hvdlint: disable=HVD006(persistence is best-effort; the in-memory store stays authoritative)
             except Exception:
                 pass

@@ -105,6 +105,7 @@ def _discover_coordinator_ip(host_list, settings):
             try:
                 services.LaunchTaskClient(
                     i, driver.task_addresses(i), settings.key).shutdown_task()
+            # hvdlint: disable=HVD006(best-effort farewell to probe tasks already being torn down)
             except Exception:
                 pass
         # jax.distributed has process 0 BIND the coordinator socket, so the

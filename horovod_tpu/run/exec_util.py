@@ -79,23 +79,27 @@ def terminate_trees(procs, grace_s=1.5):
     for p in live:
         try:
             os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+        # hvdlint: disable=HVD006(signal race with a process that already exited)
         except Exception:  # noqa: BLE001 — already exited / reaped
             pass
     deadline = time.monotonic() + grace_s
     for p in live:
         try:
             p.wait(timeout=max(0.0, deadline - time.monotonic()))
+        # hvdlint: disable=HVD006(grace wait may expire; SIGKILL pass follows)
         except Exception:  # noqa: BLE001 — still running
             pass
     for p in live:
         if p.poll() is None:
             try:
                 os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            # hvdlint: disable=HVD006(kill race with a process that just exited)
             except Exception:  # noqa: BLE001 — lost the race, fine
                 pass
     for p in live:  # reap: SIGKILL is asynchronous; don't leave zombies
         try:
             p.wait(timeout=2.0)
+        # hvdlint: disable=HVD006(reap is best-effort; a wedged child must not hang teardown)
         except Exception:  # noqa: BLE001 — truly wedged; move on
             pass
 
@@ -107,8 +111,10 @@ def terminate_tree(proc, grace_s=5.0):
     try:
         os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
         proc.wait(timeout=grace_s)
+    # hvdlint: disable=HVD006(TERM failed or grace expired; escalate to KILL)
     except Exception:
         try:
             os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        # hvdlint: disable=HVD006(kill race with a process that just exited)
         except Exception:
             pass

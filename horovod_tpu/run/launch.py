@@ -173,6 +173,7 @@ def worker_main():
     fn, args, kwargs = client.fetch_function()
     try:
         result = fn(*args, **kwargs)
+    # hvdlint: disable=HVD006(failure is reported to the launcher and the worker exits with a typed code)
     except BaseException as exc:
         from ..common.exceptions import RanksLostError
         client.report(rank, False, traceback.format_exc())

@@ -1,8 +1,9 @@
 """Launch settings + deadline helper (reference
 horovod/run/common/util/settings.py, timeout.py)."""
 
-import time
 from dataclasses import dataclass, field
+
+from ..utils.metrics import shared_clock
 
 
 class TimeoutException(Exception):
@@ -11,17 +12,20 @@ class TimeoutException(Exception):
 
 class Timeout:
     """Absolute deadline with a contextual error message
-    (reference timeout.py:19-45)."""
+    (reference timeout.py:19-45). Deadlines ride the shared monotonic
+    clock: an NTP step during a slow launch must not expire (or extend)
+    the registration window."""
 
     def __init__(self, timeout_s, message):
-        self._deadline = time.time() + timeout_s
+        self._clock = shared_clock()
+        self._deadline_us = self._clock.ts_us() + int(timeout_s * 1e6)
         self._message = message
 
     def remaining(self):
-        return max(0.0, self._deadline - time.time())
+        return max(0.0, (self._deadline_us - self._clock.ts_us()) / 1e6)
 
     def timed_out(self):
-        return time.time() > self._deadline
+        return self._clock.ts_us() > self._deadline_us
 
     def check(self):
         if self.timed_out():

@@ -69,6 +69,7 @@ class _NativeEngine:
     def __del__(self):
         try:
             self._lib.hvd_autotune_destroy(self._ptr)
+        # hvdlint: disable=HVD006(__del__ during interpreter shutdown; ctypes may be half-torn-down)
         except Exception:
             pass
 

@@ -41,6 +41,8 @@ import os
 import tempfile
 import time
 
+from . import metrics as metrics_mod
+
 # Horovod lanes are re-numbered into this range so they can never collide
 # with the profiler's pids (xplane pids are small ints too).
 _HVD_PID_BASE = 1_000_000
@@ -106,7 +108,7 @@ def _profiler_epoch_us_from_xplane(session_dir):
         return None
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:
+    except ImportError:
         return None
     space = xplane_pb2.XSpace()
     with open(paths[0], "rb") as f:
@@ -183,6 +185,7 @@ def _drain_timeline(timeline, timeout_s=5.0):
         try:
             if timeline.pending() == 0:
                 break
+        # hvdlint: disable=HVD006(drain is best-effort; a dead writer means nothing more will flush)
         except Exception:
             break
         time.sleep(0.02)
@@ -215,7 +218,9 @@ def capture(out_path, profiler_dir=None):
     own_dir = profiler_dir is None
     if own_dir:
         profiler_dir = tempfile.mkdtemp(prefix="hvd-merged-trace-")
-    epoch_us = time.time_ns() / 1e3
+    # same epoch anchor the timeline stamps with, so the xplane fallback
+    # alignment and the timeline's clock_sync agree to the microsecond
+    epoch_us = float(metrics_mod.shared_clock().epoch_us())
     jax.profiler.start_trace(profiler_dir)
     ok = False
     try:

@@ -299,6 +299,7 @@ class MetricsRegistry:
             try:
                 f.write(json.dumps(ev) + "\n")
                 f.flush()
+            # hvdlint: disable=HVD006(event sink death must never propagate into instrumented code)
             except Exception:  # noqa: BLE001 — sink death must not raise
                 self._event_file = None
         return ev
@@ -714,9 +715,11 @@ class MetricsServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                # hvdlint: disable=HVD006(a failed scrape must not kill the metrics server)
                 except Exception:  # noqa: BLE001 — scrape must not kill
                     try:
                         self.send_error(500)
+                    # hvdlint: disable=HVD006(client hung up mid-error; nothing left to tell it)
                     except Exception:  # noqa: BLE001
                         pass
 
@@ -760,6 +763,7 @@ class MetricsServer:
         try:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # hvdlint: disable=HVD006(server teardown is best-effort at exit)
         except Exception:  # noqa: BLE001 — teardown best-effort
             pass
 

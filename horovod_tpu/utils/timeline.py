@@ -126,6 +126,7 @@ class Timeline:
             try:
                 self._file.write(json.dumps(event) + ",\n")
                 self._file.flush()
+            # hvdlint: disable=HVD006(writer marks itself unhealthy; tracing degrades instead of crashing training)
             except Exception:
                 self._healthy = False
                 return
@@ -138,6 +139,7 @@ class Timeline:
             # as the reference which never closes the array; close it anyway.
             self._file.write("{}]\n")
             self._file.close()
+        # hvdlint: disable=HVD006(closing an already-dead trace file at exit)
         except Exception:
             pass
 

@@ -1,0 +1,550 @@
+"""The analyzer analyzed: per-rule trigger / non-trigger / suppression
+fixtures for tools/hvdlint, plus the end-to-end gate asserting the repo
+itself lints clean (zero unbaselined findings — the same invocation CI
+runs first).
+
+Fixture snippets are written to tmp_path and scanned with
+``analyze_paths``; role-scoped rules (HVD001/HVD003) opt in via the
+``# hvdlint: role=`` marker instead of the built-in path lists, which is
+exactly how any new module would.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.hvdlint import analyze_paths
+from tools.hvdlint.engine import iter_python_files
+from tools.hvdlint.rules import RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a minimal config.py stand-in for HVD005 tests: exactly one aliased and
+# one exact-name variable registered
+FAKE_REGISTRY = textwrap.dedent("""\
+    ENV_REGISTRY = (
+        ("HOROVOD_CYCLE_TIME", True, "5.0", "common/config.py",
+         "Cycle time."),
+        ("HVD_COORDINATOR_ADDR", False, None, "mpi_ops.py",
+         "Coordinator address."),
+    )
+""")
+
+
+def lint_source(tmp_path, source, name="snippet.py", registry=None,
+                baseline=None):
+    """Write one fixture file and return its live + suppressed findings."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(registry if registry is not None else FAKE_REGISTRY)
+    findings, _ = analyze_paths(
+        [str(f)], baseline_path=baseline, env_registry_path=str(reg))
+    return findings
+
+
+def live(findings, rule=None):
+    return [f for f in findings if not f.suppressed and
+            (rule is None or f.rule == rule)]
+
+
+# ---------------------------------------------------------------------------
+# HVD001 — rank-divergent iteration
+# ---------------------------------------------------------------------------
+
+def test_hvd001_triggers_on_set_iteration_in_wire_module(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=wire
+        pending = set()
+
+        def plan():
+            return [name for name in pending]
+        """)
+    assert [f.rule for f in live(found)] == ["HVD001"]
+
+
+def test_hvd001_triggers_on_list_of_set_attribute(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=wire
+        class Coord:
+            def __init__(self):
+                self._lost = set()
+
+            def response(self):
+                return list(self._lost)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD001"]
+
+
+def test_hvd001_sorted_and_dict_iteration_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=wire
+        pending = set()
+        table = {}
+
+        def plan():
+            for name in sorted(pending):
+                yield name
+            for key in table:  # dicts are insertion-ordered: identical
+                yield key      # across ranks by construction
+        """)
+    assert live(found) == []
+
+
+def test_hvd001_ignores_non_wire_modules(tmp_path):
+    found = lint_source(tmp_path, """\
+        pending = set()
+
+        def local_only():
+            return [n for n in pending]
+        """)
+    assert live(found) == []
+
+
+def test_hvd001_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=wire
+        pending = set()
+
+        def plan():
+            # hvdlint: disable=HVD001(order feeds a local cache, never the wire)
+            return [name for name in pending]
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD001"]
+
+
+# ---------------------------------------------------------------------------
+# HVD002 — lock order / self-deadlock
+# ---------------------------------------------------------------------------
+
+def test_hvd002_triggers_on_direct_reacquire(tmp_path):
+    found = lint_source(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+
+        def leaf():
+            with _lock:
+                with _lock:
+                    return 1
+        """)
+    assert [f.rule for f in live(found)] == ["HVD002"]
+
+
+def test_hvd002_triggers_on_call_graph_reacquire(tmp_path):
+    # the metrics-registry reset() bug shape: hold the lock, call a
+    # function whose body takes it again
+    found = lint_source(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+
+        def get_thing():
+            with _lock:
+                return 1
+
+        def reset():
+            with _lock:
+                return get_thing()
+        """)
+    assert [f.rule for f in live(found)] == ["HVD002"]
+
+
+def test_hvd002_triggers_on_inconsistent_order(tmp_path):
+    found = lint_source(tmp_path, """\
+        import threading
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:
+                    pass
+
+        def two():
+            with b:
+                with a:
+                    pass
+        """)
+    assert any(f.rule == "HVD002" and "inconsistent" in f.message
+               for f in live(found))
+
+
+def test_hvd002_rlock_reentry_is_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        import threading
+        _lock = threading.RLock()
+
+        def outer():
+            with _lock:
+                return inner()
+
+        def inner():
+            with _lock:
+                return 1
+        """)
+    assert live(found) == []
+
+
+def test_hvd002_release_before_call_is_clean(tmp_path):
+    # the fixed shape of reset(): the call happens after the with-region
+    found = lint_source(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+
+        def get_thing():
+            with _lock:
+                return 1
+
+        def reset():
+            with _lock:
+                pass
+            return get_thing()
+        """)
+    assert live(found) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD003 — blocking call in the coordinator loop
+# ---------------------------------------------------------------------------
+
+def test_hvd003_triggers_on_unbounded_blocking(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=loop
+        import socket
+        import time
+
+        def cycle(sock, thread):
+            time.sleep(5)
+            socket.create_connection(("peer", 1))
+            thread.join()
+        """)
+    assert [f.rule for f in live(found)] == ["HVD003"] * 3
+
+
+def test_hvd003_bounded_calls_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=loop
+        import socket
+        import time
+
+        def cycle(sock, thread, cycle_time_s):
+            time.sleep(0.005)
+            time.sleep(cycle_time_s)
+            socket.create_connection(("peer", 1), timeout=2.0)
+            thread.join(timeout=1.0)
+        """)
+    assert live(found) == []
+
+
+def test_hvd003_ignores_modules_without_loop_role(tmp_path):
+    found = lint_source(tmp_path, """\
+        import time
+
+        def launcher_wait():
+            time.sleep(30)
+        """)
+    assert live(found) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD004 — raw wall clock
+# ---------------------------------------------------------------------------
+
+def test_hvd004_triggers_on_time_time_and_from_import(tmp_path):
+    found = lint_source(tmp_path, """\
+        import time
+        from time import time as now
+
+        def stamp():
+            return time.time(), time.time_ns(), now()
+        """)
+    assert [f.rule for f in live(found)] == ["HVD004"] * 3
+
+
+def test_hvd004_monotonic_and_shared_clock_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        import time
+
+        def stamp(clock):
+            return time.monotonic(), time.perf_counter(), clock.ts_us()
+        """)
+    assert live(found) == []
+
+
+def test_hvd004_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        import time
+
+        def wall_stamp():
+            return time.time()  # hvdlint: disable=HVD004(cross-process stamp)
+        """)
+    assert live(found) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD005 — env-registry drift
+# ---------------------------------------------------------------------------
+
+def test_hvd005_triggers_on_unregistered_reads(tmp_path):
+    found = lint_source(tmp_path, """\
+        import os
+        from horovod_tpu.common.config import env_int
+
+        a = os.environ.get("HVD_NOT_REGISTERED")
+        b = os.environ["HOROVOD_ALSO_MISSING"]
+        c = "HVD_THIRD_ONE" in os.environ
+        d = env_int("BRAND_NEW_KNOB", 3)
+        """)
+    hits = live(found, "HVD005")
+    assert len(hits) == 4
+    assert "HVD_NOT_REGISTERED" in hits[0].message
+
+
+def test_hvd005_registered_reads_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        import os
+        from horovod_tpu.common.config import env_float
+
+        a = os.environ.get("HVD_COORDINATOR_ADDR")
+        b = env_float("CYCLE_TIME", 5.0)   # aliased HOROVOD_/HVD_
+        c = os.environ.get("HVD_CYCLE_TIME")  # the alias spelling
+        d = os.environ.get("PATH")  # non-HVD names are out of scope
+        """)
+    assert live(found) == []
+
+
+def test_hvd005_real_registry_parses_without_import(tmp_path):
+    from tools.hvdlint import envdoc
+    entries = envdoc.load_env_registry()
+    names = {e["name"] for e in entries}
+    assert "HOROVOD_FUSION_THRESHOLD" in names
+    assert "HVD_COORDINATOR_ADDR" in names
+    assert len(entries) >= 49
+    lookup = envdoc.registry_lookup(entries)
+    assert "HVD_FUSION_THRESHOLD" in lookup  # alias spelling
+
+
+# ---------------------------------------------------------------------------
+# HVD006 — swallowed exception
+# ---------------------------------------------------------------------------
+
+def test_hvd006_triggers_on_silent_broad_except(tmp_path):
+    found = lint_source(tmp_path, """\
+        def fetch(client):
+            try:
+                return client.cycle()
+            except Exception:
+                pass
+        """)
+    assert [f.rule for f in live(found)] == ["HVD006"]
+
+
+def test_hvd006_narrow_logged_or_reraised_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def fetch(client):
+            try:
+                return client.cycle()
+            except ConnectionError:
+                return None
+
+        def fetch2(client):
+            try:
+                return client.cycle()
+            except Exception as exc:
+                log.warning("cycle failed: %s", exc)
+                return None
+
+        def fetch3(client):
+            try:
+                return client.cycle()
+            except Exception:
+                raise
+        """)
+    assert live(found) == []
+
+
+def test_hvd006_suppression_with_reason_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        def close(sock):
+            try:
+                sock.close()
+            # hvdlint: disable=HVD006(teardown is best-effort)
+            except Exception:
+                pass
+        """)
+    assert live(found) == []
+
+
+def test_reasonless_suppression_is_integrity_finding(tmp_path):
+    found = lint_source(tmp_path, """\
+        def close(sock):
+            try:
+                sock.close()
+            except Exception:  # hvdlint: disable=HVD006
+                pass
+        """)
+    rules = sorted(f.rule for f in live(found))
+    # the disable does NOT suppress, and is itself reported
+    assert rules == ["HVD000", "HVD006"]
+
+
+# ---------------------------------------------------------------------------
+# HVD007 — jit purity
+# ---------------------------------------------------------------------------
+
+def test_hvd007_triggers_on_side_effects_in_traced_fn(tmp_path):
+    found = lint_source(tmp_path, """\
+        import functools
+        import os
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("tracing")
+            return x * time.time()
+
+        @functools.partial(jax.jit, static_argnums=1)
+        def step2(x, n):
+            return x * float(os.environ.get("HVD_COORDINATOR_ADDR", 1))
+        """)
+    # (the raw time.time() also trips HVD004 — that rule is file-wide)
+    assert [f.rule for f in live(found, "HVD007")] == ["HVD007"] * 3
+
+
+def test_hvd007_pure_traced_and_impure_untraced_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x * 2.0)
+
+        def host_side():
+            print("not traced, print away")
+        """)
+    assert live(found) == []
+
+
+def test_hvd007_catches_lambda_passed_to_jit(tmp_path):
+    found = lint_source(tmp_path, """\
+        import jax
+
+        _replicate = jax.jit(lambda x: print(x) or x)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD007"]
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+def test_baseline_consumes_match_and_requires_reason(tmp_path):
+    src = """\
+        import time
+
+        def stamp():
+            return time.time()
+        """
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "file": str(tmp_path / "snippet.py"), "rule": "HVD004",
+        "match": "return time.time()", "count": 1,
+        "reason": "wall stamp compared across processes"}]}))
+    found = lint_source(tmp_path, src, baseline=str(bl))
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "baseline"] == \
+        ["HVD004"]
+
+    # an empty reason turns the entry itself into a finding
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "file": str(tmp_path / "snippet.py"), "rule": "HVD004",
+        "match": "return time.time()", "count": 1, "reason": ""}]}))
+    found = lint_source(tmp_path, src, baseline=str(bl))
+    assert sorted(f.rule for f in live(found)) == ["HVD000"]
+
+
+def test_stale_baseline_entry_is_reported(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "file": str(tmp_path / "snippet.py"), "rule": "HVD004",
+        "match": "return time.time()", "count": 1,
+        "reason": "was a wall stamp"}]}))
+    found = lint_source(tmp_path, "x = 1\n", baseline=str(bl))
+    hits = live(found, "HVD000")
+    assert len(hits) == 1 and "stale" in hits[0].message
+
+
+def test_syntax_error_is_integrity_finding(tmp_path):
+    found = lint_source(tmp_path, "def broken(:\n")
+    assert [f.rule for f in live(found)] == ["HVD000"]
+
+
+def test_walk_excludes_pycache_and_native(tmp_path):
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text("x = 1\n")
+    (tmp_path / "_native").mkdir()
+    (tmp_path / "_native" / "gen.py").write_text("x = 1\n")
+    (tmp_path / "real.py").write_text("x = 1\n")
+    files = iter_python_files([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == ["real.py"]
+
+
+# ---------------------------------------------------------------------------
+# rule catalog + CLI + end-to-end gate
+# ---------------------------------------------------------------------------
+
+def test_every_rule_has_catalog_entry():
+    assert sorted(RULES) == [f"HVD00{i}" for i in range(1, 8)]
+    for rule in RULES.values():
+        assert rule.summary
+        assert len(rule.explain) > 200  # the full story, not a stub
+
+
+def test_cli_explain_and_json(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--explain", "HVD002"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert out.returncode == 0
+    assert "reset()" in out.stdout
+
+    snippet = tmp_path / "s.py"
+    snippet.write_text("import time\nt = time.time()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", str(snippet),
+         "--format", "json", "--baseline", "none"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert out.returncode == 1
+    payload = json.loads(out.stdout)
+    assert payload["live"] == 1
+    assert payload["findings"][0]["rule"] == "HVD004"
+
+
+@pytest.mark.slow
+def test_repo_lints_clean_end_to_end():
+    """The CI gate itself: zero unbaselined findings over the repo."""
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint",
+         "horovod_tpu", "tools", "bench.py"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+@pytest.mark.slow
+def test_envdoc_matches_registry_end_to_end():
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--check-envdoc"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -1,0 +1,2 @@
+# Namespace for developer tooling (hvdlint, benches). Kept importable so
+# `python -m tools.hvdlint` works from a repo checkout without installing.

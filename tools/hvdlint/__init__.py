@@ -1,0 +1,36 @@
+"""hvdlint: distributed-correctness static analysis for horovod_tpu.
+
+A dependency-free (stdlib ``ast``) analyzer whose rules each encode an
+invariant this repo has actually been bitten by violating:
+
+  HVD001  rank-divergent iteration   (unsorted set iteration feeding
+                                      cross-rank wire messages)
+  HVD002  lock-order / deadlock      (the metrics-registry ``reset()``
+                                      self-deadlock class)
+  HVD003  blocking call in the       (unbounded sleep/socket/file I/O at
+          coordinator loop            cycle cadence)
+  HVD004  raw wall clock             (``time.time()`` instead of the
+                                      shared ``Clock`` anchor)
+  HVD005  env-registry drift         (HVD_*/HOROVOD_* reads missing from
+                                      ``common/config.py:ENV_REGISTRY``)
+  HVD006  swallowed exception        (broad excepts that neither raise
+                                      nor log on control/data paths)
+  HVD007  jit purity                 (Python side effects inside
+                                      jit/pjit/pallas-traced functions)
+
+Run ``python -m tools.hvdlint --explain HVDnnn`` for the full story of
+each rule, including the historical bug it encodes. Docs: docs/hvdlint.md.
+
+Suppression syntax (reason is mandatory — a reasonless disable does not
+suppress and is itself reported)::
+
+    do_the_thing()  # hvdlint: disable=HVD004(cross-process wall stamp)
+
+Checked-in baseline: tools/hvdlint/baseline.json (see docs/hvdlint.md for
+the workflow). CI gate: the first stage of ci/run_tests.sh.
+"""
+
+from .engine import Finding, analyze_paths, load_baseline  # noqa: F401
+from .rules import RULES  # noqa: F401
+
+__all__ = ["Finding", "analyze_paths", "load_baseline", "RULES"]

@@ -1,0 +1,250 @@
+"""hvdlint engine: file walk, suppressions, baseline, rule dispatch.
+
+Deliberately dependency-free (stdlib only) and import-free of
+``horovod_tpu`` itself: the analyzer must run before the package is
+importable (no jax in the CI lint stage) and must never execute the code
+it judges. Everything is derived from source text + ``ast``.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+# hash-space-hvdlint colon disable=HVD004(reason), HVD006(other) — the
+# reason is MANDATORY: a reasonless disable suppresses nothing and is
+# itself reported (HVD000), so every intentional violation stays
+# explained in the diff that introduces it. The negative lookbehind
+# keeps markers QUOTED in prose (backticks/quotes, like this comment)
+# from registering as live ones.
+_SUPPRESS_RE = re.compile(
+    r"(?<![#`'\"])#\s*hvdlint:\s*disable=(?P<items>.+)$")
+_ITEM_RE = re.compile(r"(HVD\d{3})\s*(\(([^()]*)\))?")
+# hash-space-hvdlint colon role=wire,loop — lets a module (or a test
+# fixture) declare itself subject to the module-scoped rules without
+# being on the built-in path lists in rules.py. Must be a standalone
+# comment line (anchored), so prose mentions never count.
+_ROLE_RE = re.compile(r"^\s*#\s*hvdlint:\s*role=(?P<roles>[a-z, ]+)")
+
+_EXCLUDED_DIRS = {"__pycache__", "_native", ".git", ".github", "build",
+                  "dist", ".claude", "node_modules"}
+
+INTEGRITY_RULE = "HVD000"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    col: int
+    message: str
+    # "" = live finding; "inline"/"baseline" = suppressed (kept for
+    # --show-suppressed and for stale-baseline accounting)
+    suppressed: str = ""
+
+    def format(self):
+        return (f"{self.file}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Parsed view of one source file handed to every rule."""
+
+    def __init__(self, relpath, source):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        _attach_parents(self.tree)
+        # line -> {code: reason}; reasonless disables recorded separately
+        self.suppressions = {}
+        self.bad_suppressions = []  # (line, code)
+        self.roles = set()
+        self._scan_comments()
+
+    def _scan_comments(self):
+        for i, text in enumerate(self.lines, start=1):
+            role_m = _ROLE_RE.search(text)
+            if role_m:
+                self.roles.update(
+                    r.strip() for r in role_m.group("roles").split(",")
+                    if r.strip())
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            for code, paren, reason in _ITEM_RE.findall(m.group("items")):
+                if paren and reason.strip():
+                    self.suppressions.setdefault(i, {})[code] = \
+                        reason.strip()
+                else:
+                    self.bad_suppressions.append((i, code))
+
+    def suppression_for(self, rule, line):
+        """A disable applies on the finding's own line, or as a
+        standalone comment on the line directly above it."""
+        entry = self.suppressions.get(line, {})
+        if rule in entry:
+            return entry[rule]
+        above = self.suppressions.get(line - 1, {})
+        if rule in above and line - 2 < len(self.lines) and \
+                self.lines[line - 2].lstrip().startswith("#"):
+            return above[rule]
+        return None
+
+
+def _attach_parents(tree):
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.hvdlint_parent = node
+
+
+def iter_python_files(paths):
+    """Yield (relpath) for every .py under the given files/dirs,
+    deterministic order, skipping build/caches/_native artifacts."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in _EXCLUDED_DIRS and
+                             not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return sorted(dict.fromkeys(os.path.normpath(p).replace(os.sep, "/")
+                                for p in out))
+
+
+def load_baseline(path):
+    """Baseline schema: {"version": 1, "entries": [{file, rule, match,
+    reason, count?}]}. ``match`` is the stripped text of the offending
+    line — line numbers drift, code rarely does; a moved-but-unchanged
+    violation stays baselined, an edited one resurfaces for review."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def render_baseline(findings):
+    """Build baseline entries for the given live findings (the
+    --write-baseline output). Reasons start empty on purpose: the file
+    fails the reason check until a human writes one per entry."""
+    counts = {}
+    line_cache = {}
+    for f in findings:
+        if f.file not in line_cache:
+            try:
+                with open(f.file, encoding="utf-8") as fh:
+                    line_cache[f.file] = fh.read().splitlines()
+            except OSError:
+                line_cache[f.file] = []
+        lines = line_cache[f.file]
+        match = lines[f.line - 1].strip() if 0 < f.line <= len(lines) \
+            else ""
+        key = (f.file, f.rule, match)
+        counts[key] = counts.get(key, 0) + 1
+    entries = [{"file": file, "rule": rule, "match": match,
+                "count": n, "reason": ""}
+               for (file, rule, match), n in sorted(counts.items())]
+    return {"version": 1, "entries": entries}
+
+
+class _BaselineIndex:
+    def __init__(self, entries, baseline_path):
+        self.path = baseline_path
+        self.entries = entries
+        self._remaining = {}
+        self.bad = []  # entries with empty reason
+        for e in entries:
+            key = (e.get("file"), e.get("rule"), e.get("match"))
+            self._remaining[key] = self._remaining.get(key, 0) + \
+                int(e.get("count", 1))
+            if not str(e.get("reason", "")).strip():
+                self.bad.append(e)
+
+    def consume(self, finding, line_text):
+        key = (finding.file, finding.rule, line_text)
+        if self._remaining.get(key, 0) > 0:
+            self._remaining[key] -= 1
+            return True
+        return False
+
+    def stale_entries(self, scanned_files):
+        scanned = set(scanned_files)
+        stale = []
+        for (file, rule, match), left in sorted(self._remaining.items()):
+            if left > 0 and file in scanned:
+                stale.append((file, rule, match, left))
+        return stale
+
+
+def analyze_paths(paths, baseline_path=None, env_registry_path=None,
+                  rules=None):
+    """Run every rule over the given paths.
+
+    Returns (findings, scanned_files). ``findings`` includes suppressed
+    ones (``suppressed`` set to "inline"/"baseline") so callers can show
+    or count them; live findings are those with ``suppressed == ""``.
+    """
+    from . import rules as rules_mod
+    active = rules if rules is not None else rules_mod.RULES
+    shared = rules_mod.SharedState(env_registry_path)
+    files = iter_python_files(paths)
+    baseline = _BaselineIndex(
+        load_baseline(baseline_path) if baseline_path else [],
+        baseline_path)
+
+    findings = []
+    for relpath in files:
+        with open(relpath, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            ctx = FileContext(relpath, source)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                INTEGRITY_RULE, relpath, exc.lineno or 1, 0,
+                f"file does not parse: {exc.msg}"))
+            continue
+        for line, code in ctx.bad_suppressions:
+            findings.append(Finding(
+                INTEGRITY_RULE, relpath, line, 0,
+                f"suppression for {code} has no reason — use "
+                f"`# hvdlint: disable={code}(why this is intentional)`"))
+        for rule in active.values():
+            for f in rule.check(ctx, shared):
+                reason = ctx.suppression_for(f.rule, f.line)
+                if reason is not None:
+                    f.suppressed = "inline"
+                else:
+                    idx = f.line - 1
+                    line_text = (ctx.lines[idx].strip()
+                                 if 0 <= idx < len(ctx.lines) else "")
+                    if baseline.consume(f, line_text):
+                        f.suppressed = "baseline"
+                findings.append(f)
+
+    for e in baseline.bad:
+        findings.append(Finding(
+            INTEGRITY_RULE, baseline.path or "baseline", 1, 0,
+            f"baseline entry for {e.get('file')}:{e.get('rule')} "
+            f"({e.get('match')!r}) has no reason — every accepted "
+            "violation must say why"))
+    for file, rule, match, left in baseline.stale_entries(files):
+        findings.append(Finding(
+            INTEGRITY_RULE, baseline.path or "baseline", 1, 0,
+            f"stale baseline entry: {file}:{rule} ({match!r}) matched "
+            f"{left} fewer finding(s) than recorded — the violation was "
+            "fixed or the line changed; remove or update the entry"))
+
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, files

@@ -1,0 +1,141 @@
+"""Env-var registry loader + docs/envvars.md generator (HVD005 backend).
+
+The single source of truth is ``ENV_REGISTRY`` in
+``horovod_tpu/common/config.py`` — a pure tuple-of-tuples literal:
+
+    (name, aliased, default, owner, description)
+
+``aliased`` marks variables read through the config helpers, which try
+``HOROVOD_<suffix>`` then ``HVD_<suffix>``; for those, ``name`` is the
+canonical ``HOROVOD_*`` form and both spellings satisfy HVD005.
+
+This module PARSES the registry with ``ast.literal_eval`` — it never
+imports ``horovod_tpu``, so the lint stage runs without jax installed.
+"""
+
+import ast
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_REGISTRY_PATH = os.path.join(
+    REPO_ROOT, "horovod_tpu", "common", "config.py")
+DEFAULT_DOC_PATH = os.path.join(REPO_ROOT, "docs", "envvars.md")
+
+_FIELDS = ("name", "aliased", "default", "owner", "description")
+
+
+def load_env_registry(path=None):
+    """Extract and validate ENV_REGISTRY from config.py without
+    importing it. Returns a list of dicts with _FIELDS keys."""
+    path = path or DEFAULT_REGISTRY_PATH
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    literal = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "ENV_REGISTRY":
+                    literal = node.value
+    if literal is None:
+        raise ValueError(f"no ENV_REGISTRY assignment in {path}")
+    raw = ast.literal_eval(literal)  # raises if not a pure literal
+    entries = []
+    seen = set()
+    for i, row in enumerate(raw):
+        if not (isinstance(row, tuple) and len(row) == len(_FIELDS)):
+            raise ValueError(
+                f"ENV_REGISTRY[{i}] must be a {len(_FIELDS)}-tuple "
+                f"{_FIELDS}, got {row!r}")
+        entry = dict(zip(_FIELDS, row))
+        if not isinstance(entry["name"], str) or not entry["name"]:
+            raise ValueError(f"ENV_REGISTRY[{i}]: bad name {row!r}")
+        if entry["name"] in seen:
+            raise ValueError(
+                f"ENV_REGISTRY: duplicate entry for {entry['name']}")
+        seen.add(entry["name"])
+        if not str(entry["description"]).strip():
+            raise ValueError(
+                f"ENV_REGISTRY: {entry['name']} has no description")
+        entries.append(entry)
+    return entries
+
+
+def registry_lookup(entries):
+    """All env-var spellings the registry covers (aliased entries match
+    under both prefixes)."""
+    names = set()
+    for e in entries:
+        names.add(e["name"])
+        if e["aliased"] and e["name"].startswith("HOROVOD_"):
+            names.add("HVD_" + e["name"][len("HOROVOD_"):])
+    return frozenset(names)
+
+
+def render_markdown(entries):
+    """The full generated text of docs/envvars.md."""
+    lines = [
+        "# Environment variables",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand."
+        " Source: ENV_REGISTRY in horovod_tpu/common/config.py."
+        " Regenerate: python -m tools.hvdlint --emit-envdoc -->",
+        "",
+        "Every `HVD_*`/`HOROVOD_*` variable the framework reads, "
+        "generated from the single registry in "
+        "`horovod_tpu/common/config.py`. The lint rule "
+        "[HVD005](hvdlint.md#hvd005) fails CI when code reads a "
+        "variable that is not listed here, and `--check-envdoc` fails "
+        "CI when this file drifts from the registry.",
+        "",
+        "Variables marked *aliased* are read through the config "
+        "helpers, which try the `HOROVOD_` spelling first and fall "
+        "back to `HVD_` — both work; the `HOROVOD_` form is canonical "
+        "(matching upstream Horovod's knob names). Variables with a "
+        "leading underscore are internal launcher plumbing "
+        "(`hvdrun` exports them to workers); set them by hand only "
+        "when debugging the launcher itself.",
+        "",
+        "| Variable | Aliased | Default | Owner | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for e in sorted(entries, key=lambda e: e["name"]):
+        default = e["default"]
+        default_txt = "*(unset)*" if default is None else \
+            f"`{default}`"
+        lines.append(
+            "| `{name}` | {aliased} | {default} | `{owner}` | {desc} |"
+            .format(name=e["name"],
+                    aliased="yes" if e["aliased"] else "",
+                    default=default_txt,
+                    owner=e["owner"],
+                    desc=str(e["description"]).replace("|", "\\|")))
+    lines += [
+        "",
+        f"{len(entries)} variables registered.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def write_doc(entries, doc_path=None):
+    doc_path = doc_path or DEFAULT_DOC_PATH
+    os.makedirs(os.path.dirname(doc_path), exist_ok=True)
+    with open(doc_path, "w", encoding="utf-8") as f:
+        f.write(render_markdown(entries))
+    return doc_path
+
+
+def check_doc(entries, doc_path=None):
+    """Return None if the doc matches the registry, else a message."""
+    doc_path = doc_path or DEFAULT_DOC_PATH
+    want = render_markdown(entries)
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            have = f.read()
+    except OSError as exc:
+        return f"cannot read {doc_path}: {exc}"
+    if have != want:
+        return (f"{doc_path} is out of date with ENV_REGISTRY — "
+                "regenerate with `python -m tools.hvdlint --emit-envdoc`")
+    return None

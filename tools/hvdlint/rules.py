@@ -1,0 +1,887 @@
+"""The hvdlint rule set. Every rule encodes a bug class this repo has
+actually hit (or a sibling of one); ``--explain HVDnnn`` prints the
+``explain`` text below, history included.
+
+Module roles
+------------
+Two rules are scoped to modules with a declared *role* instead of the
+whole tree, because their invariants only hold on specific planes:
+
+  wire  — code that builds or orders cross-rank messages
+          (CycleRequest/CycleResponse, fusion plans). HVD001 applies.
+  loop  — code that runs inside the paced coordinator/background cycle.
+          HVD003 applies.
+
+Roles come from the path lists below, or from a
+``# hvdlint: role=wire,loop`` comment in the file (how test fixtures —
+and any future module — opt in without editing this file).
+"""
+
+import ast
+import dataclasses
+import re
+
+from .engine import Finding
+
+WIRE_MODULE_SUFFIXES = (
+    "horovod_tpu/ops/negotiation.py",
+    "horovod_tpu/ops/eager.py",
+    "horovod_tpu/ops/fusion.py",
+)
+LOOP_MODULE_SUFFIXES = (
+    "horovod_tpu/ops/negotiation.py",
+    "horovod_tpu/ops/eager.py",
+)
+
+_ENV_NAME_RE = re.compile(r"^(HVD|HOROVOD)_[A-Z0-9_]+$")
+# common/config.py-style helpers: the literal gets a HOROVOD_/HVD_ prefix
+_ENV_HELPERS = {"_env", "env_bool", "env_int", "env_float", "env_str"}
+# mpi_ops-style helper: literal args are FULL env var names
+_ENV_FULLNAME_HELPERS = {"_env_first"}
+
+_LOG_CALL_NAMES = {"debug", "info", "warning", "warn", "error",
+                   "exception", "critical", "event", "print_exc",
+                   "print"}
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _roles_for(ctx):
+    roles = set(ctx.roles)
+    for suffix in WIRE_MODULE_SUFFIXES:
+        if ctx.relpath.endswith(suffix):
+            roles.add("wire")
+    for suffix in LOOP_MODULE_SUFFIXES:
+        if ctx.relpath.endswith(suffix):
+            roles.add("loop")
+    return roles
+
+
+def _attr_chain(node):
+    """foo.bar.baz -> ["foo", "bar", "baz"]; None if not a pure chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _iter_function_defs(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _enclosing_class(node):
+    cur = getattr(node, "hvdlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = getattr(cur, "hvdlint_parent", None)
+    return None
+
+
+class SharedState:
+    """Cross-file inputs the rules need: the env registry parsed (not
+    imported) from common/config.py. Loaded once per run."""
+
+    def __init__(self, env_registry_path=None):
+        from . import envdoc
+        self.env_registry_path = (env_registry_path or
+                                  envdoc.DEFAULT_REGISTRY_PATH)
+        self.env_registry = None
+        self.env_registry_error = None
+        self.env_lookup = frozenset()
+        try:
+            self.env_registry = envdoc.load_env_registry(
+                self.env_registry_path)
+            self.env_lookup = envdoc.registry_lookup(self.env_registry)
+        # hvdlint: disable=HVD006(re-surfaced as an HVD005 finding per file)
+        except Exception as exc:
+            self.env_registry_error = str(exc)
+
+
+@dataclasses.dataclass
+class Rule:
+    code: str
+    name: str
+    summary: str
+    explain: str
+    checker: object
+
+    def check(self, ctx, shared):
+        return list(self.checker(ctx, shared))
+
+
+# ---------------------------------------------------------------------------
+# HVD001 — rank-divergent iteration
+# ---------------------------------------------------------------------------
+
+_SET_METHODS = {"union", "difference", "intersection",
+                "symmetric_difference", "copy"}
+_ORDER_SAFE_WRAPPERS = {"sorted", "len", "sum", "min", "max", "any",
+                        "all", "set", "frozenset"}
+
+
+def _collect_setty_symbols(tree):
+    """Names / self-attributes the module ever assigns a set to."""
+    names, attrs = set(), set()
+
+    def is_setty(expr):
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Name) and \
+                    expr.func.id in ("set", "frozenset"):
+                return True
+            if isinstance(expr.func, ast.Attribute) and \
+                    expr.func.attr in _SET_METHODS and \
+                    is_setty(expr.func.value):
+                return True
+        if isinstance(expr, ast.BinOp) and isinstance(
+                expr.op, (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)):
+            return is_setty(expr.left) or is_setty(expr.right)
+        if isinstance(expr, ast.Name):
+            return expr.id in names
+        if isinstance(expr, ast.Attribute):
+            chain = _attr_chain(expr)
+            return (chain is not None and len(chain) == 2 and
+                    chain[0] == "self" and chain[1] in attrs)
+        return False
+
+    # two passes so `a = set(); b = a` converges for the common shapes
+    for _ in range(2):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if not is_setty(value):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    chain = _attr_chain(t)
+                    if chain and len(chain) == 2 and chain[0] == "self":
+                        attrs.add(chain[1])
+    return names, attrs, is_setty
+
+
+def check_rank_divergence(ctx, shared):
+    if "wire" not in _roles_for(ctx):
+        return
+    names, attrs, is_setty = _collect_setty_symbols(ctx.tree)
+
+    def describe(expr):
+        if isinstance(expr, ast.Name):
+            return f"set '{expr.id}'"
+        if isinstance(expr, ast.Attribute):
+            return f"set 'self.{expr.attr}'"
+        return "a set expression"
+
+    iters = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters.extend(gen.iter for gen in node.generators)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("list", "tuple") and node.args:
+            # list(a_set) / tuple(a_set) materializes the randomized
+            # order just as surely as a for-loop does
+            iters.append(node.args[0])
+        elif isinstance(node, ast.Starred):
+            iters.append(node.value)
+    for it in iters:
+        if is_setty(it):
+            yield Finding(
+                "HVD001", ctx.relpath, it.lineno, it.col_offset,
+                f"iterating {describe(it)} without sorted() in a wire "
+                "module: set order is hash-randomized and diverges "
+                "across ranks, so anything built from this order "
+                "(CycleRequest/CycleResponse contents, fusion plans) "
+                "desynchronizes the collective schedule. Wrap the "
+                "iterable in sorted().")
+
+
+# ---------------------------------------------------------------------------
+# HVD002 — lock order / self-deadlock
+# ---------------------------------------------------------------------------
+
+def _lock_defs(tree):
+    """Map lock symbols to kind. Keys: ("mod", name) for module-level
+    locks, ("cls", ClassName, attr) for self.<attr> locks."""
+    locks = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call) and
+                isinstance(value.func, ast.Attribute) and
+                isinstance(value.func.value, ast.Name) and
+                value.func.value.id == "threading" and
+                value.func.attr in ("Lock", "RLock")):
+            continue
+        kind = "rlock" if value.func.attr == "RLock" else "lock"
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                cls = _enclosing_class(node)
+                if cls is None:
+                    locks[("mod", t.id)] = kind
+                else:
+                    locks[("cls", cls.name, t.id)] = kind
+            elif isinstance(t, ast.Attribute):
+                chain = _attr_chain(t)
+                cls = _enclosing_class(node)
+                if chain and len(chain) == 2 and chain[0] == "self" and \
+                        cls is not None:
+                    locks[("cls", cls.name, chain[1])] = kind
+    return locks
+
+
+def _resolve_lock(expr, cls_name, locks):
+    """Lock key for an expression like `self._lock` / `_registry_lock`
+    (also unwraps `X.acquire`-style attribute tails upstream)."""
+    if isinstance(expr, ast.Name):
+        key = ("mod", expr.id)
+        return key if key in locks else None
+    chain = _attr_chain(expr)
+    if chain and len(chain) == 2 and chain[0] == "self" and cls_name:
+        key = ("cls", cls_name, chain[1])
+        return key if key in locks else None
+    return None
+
+
+def _direct_acquisitions(func, cls_name, locks):
+    """Lock keys a function acquires directly (with-blocks + .acquire)."""
+    acquired = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                key = _resolve_lock(item.context_expr, cls_name, locks)
+                if key:
+                    acquired.add(key)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            key = _resolve_lock(node.func.value, cls_name, locks)
+            if key:
+                acquired.add(key)
+    return acquired
+
+
+def check_lock_order(ctx, shared):
+    locks = _lock_defs(ctx.tree)
+    if not locks:
+        return []
+
+    # function tables for the one-module call graph
+    mod_funcs = {}    # name -> FunctionDef (module top level)
+    methods = {}      # (cls, name) -> FunctionDef
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod_funcs[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    methods[(node.name, sub.name)] = sub
+
+    def fkey_of_call(call, cls_name):
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in mod_funcs:
+            return ("f", func.id)
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self" and cls_name and \
+                (cls_name, func.attr) in methods:
+            return ("m", cls_name, func.attr)
+        return None
+
+    def fnode(fkey):
+        return mod_funcs[fkey[1]] if fkey[0] == "f" else methods[
+            (fkey[1], fkey[2])]
+
+    def fcls(fkey):
+        return None if fkey[0] == "f" else fkey[1]
+
+    closure_memo = {}
+
+    def closure(fkey, stack=()):
+        """Locks acquired by fkey or (transitively) its same-module
+        callees."""
+        if fkey in closure_memo:
+            return closure_memo[fkey]
+        if fkey in stack:
+            return set()
+        func = fnode(fkey)
+        acq = set(_direct_acquisitions(func, fcls(fkey), locks))
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                callee = fkey_of_call(node, fcls(fkey))
+                if callee is not None:
+                    acq |= closure(callee, stack + (fkey,))
+        closure_memo[fkey] = acq
+        return acq
+
+    findings = []
+    # (lock_a, lock_b) -> first (line, col) where b was taken under a
+    nesting_pairs = {}
+
+    def visit(node, held, cls_name):
+        if isinstance(node, ast.With):
+            new = []
+            for item in node.items:
+                key = _resolve_lock(item.context_expr, cls_name, locks)
+                if key is None:
+                    continue
+                if key in held and locks[key] == "lock":
+                    findings.append(Finding(
+                        "HVD002", ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"re-acquiring non-reentrant lock "
+                        f"'{_lock_name(key)}' already held in this "
+                        "function: guaranteed self-deadlock (the "
+                        "metrics-registry reset() bug class)."))
+                for h in held:
+                    if h != key:
+                        nesting_pairs.setdefault(
+                            (h, key), (node.lineno, node.col_offset))
+                new.append(key)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held + new, cls_name)
+            return
+        if isinstance(node, ast.Call):
+            # direct re-acquire via .acquire()
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire":
+                key = _resolve_lock(node.func.value, cls_name, locks)
+                if key is not None and key in held and \
+                        locks[key] == "lock":
+                    findings.append(Finding(
+                        "HVD002", ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"acquire() on non-reentrant lock "
+                        f"'{_lock_name(key)}' while it is already held "
+                        "in this function: guaranteed self-deadlock."))
+            # call into a same-module function that takes a held lock
+            callee = fkey_of_call(node, cls_name)
+            if callee is not None and held:
+                callee_locks = closure(callee)
+                for h in held:
+                    if h in callee_locks and locks[h] == "lock":
+                        findings.append(Finding(
+                            "HVD002", ctx.relpath, node.lineno,
+                            node.col_offset,
+                            f"call to '{_callee_name(callee)}' while "
+                            f"holding non-reentrant lock "
+                            f"'{_lock_name(h)}', which it (or a callee) "
+                            "acquires again: self-deadlock — the exact "
+                            "shape of the metrics-registry reset() bug "
+                            "fixed in the telemetry PR."))
+                    for k in callee_locks:
+                        if k != h:
+                            nesting_pairs.setdefault(
+                                (h, k), (node.lineno, node.col_offset))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, cls_name)
+
+    for name, func in mod_funcs.items():
+        visit(func, [], None)
+    for (cls, name), func in methods.items():
+        visit(func, [], cls)
+
+    # inconsistent ordering: A->B somewhere and B->A somewhere else
+    reported = set()
+    for (a, b), (line, col) in sorted(nesting_pairs.items(),
+                                      key=lambda kv: kv[1]):
+        if (b, a) in nesting_pairs and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            other_line = nesting_pairs[(b, a)][0]
+            findings.append(Finding(
+                "HVD002", ctx.relpath, line, col,
+                f"inconsistent lock order: '{_lock_name(a)}' -> "
+                f"'{_lock_name(b)}' here but '{_lock_name(b)}' -> "
+                f"'{_lock_name(a)}' at line {other_line}; two threads "
+                "taking these paths concurrently deadlock. Pick one "
+                "global order."))
+    return findings
+
+
+def _lock_name(key):
+    return key[1] if key[0] == "mod" else f"{key[1]}.{key[2]}"
+
+
+def _callee_name(fkey):
+    return fkey[1] if fkey[0] == "f" else f"{fkey[1]}.{fkey[2]}"
+
+
+# ---------------------------------------------------------------------------
+# HVD003 — blocking call in the coordinator loop
+# ---------------------------------------------------------------------------
+
+_SUBPROC_BLOCKING = {"run", "check_output", "check_call", "call",
+                     "communicate"}
+
+
+def check_blocking_in_loop(ctx, shared):
+    if "loop" not in _roles_for(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        kwargs = {k.arg for k in node.keywords}
+        if chain == ["time", "sleep"] and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, (int, float)) and \
+                node.args[0].value >= 1.0:
+            yield Finding(
+                "HVD003", ctx.relpath, node.lineno, node.col_offset,
+                f"time.sleep({node.args[0].value}) in a coordinator-loop "
+                "module: a sleep at or above 1 s stalls the negotiation "
+                "cycle (5 ms cadence) for every rank. Sleep the cycle "
+                "time, or move the wait off the loop thread.")
+        elif chain == ["socket", "create_connection"] and \
+                "timeout" not in kwargs and len(node.args) < 2:
+            yield Finding(
+                "HVD003", ctx.relpath, node.lineno, node.col_offset,
+                "socket.create_connection without a timeout in a "
+                "coordinator-loop module: a silent peer blocks the "
+                "cycle forever. Pass timeout=.")
+        elif chain and chain[-1] == "settimeout" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value is None:
+            yield Finding(
+                "HVD003", ctx.relpath, node.lineno, node.col_offset,
+                "settimeout(None) in a coordinator-loop module makes the "
+                "socket blocking with no bound; the cycle hangs with a "
+                "silent peer.")
+        elif chain and len(chain) >= 2 and chain[-1] in ("wait", "join") \
+                and not node.args and not node.keywords:
+            yield Finding(
+                "HVD003", ctx.relpath, node.lineno, node.col_offset,
+                f"unbounded .{chain[-1]}() in a coordinator-loop module: "
+                "pass a timeout so a dead peer/thread cannot hang the "
+                "cycle (liveness escalation needs the loop to keep "
+                "turning).")
+        elif isinstance(node.func, ast.Name) and node.func.id == "open":
+            yield Finding(
+                "HVD003", ctx.relpath, node.lineno, node.col_offset,
+                "file I/O in a coordinator-loop module: disk latency "
+                "(NFS, page cache miss) stalls every rank's cycle. "
+                "Queue to a writer thread (utils/timeline.py pattern).")
+        elif chain and chain[0] == "subprocess" and \
+                chain[-1] in _SUBPROC_BLOCKING and "timeout" not in kwargs:
+            yield Finding(
+                "HVD003", ctx.relpath, node.lineno, node.col_offset,
+                f"subprocess.{chain[-1]} without timeout= in a "
+                "coordinator-loop module blocks the cycle on an external "
+                "process.")
+
+
+# ---------------------------------------------------------------------------
+# HVD004 — raw wall clock
+# ---------------------------------------------------------------------------
+
+def check_raw_clock(ctx, shared):
+    # `from time import time` aliases
+    aliases = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in ("time", "time_ns"):
+                    aliases.add(a.asname or a.name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        hit = (chain in (["time", "time"], ["time", "time_ns"]) or
+               (isinstance(node.func, ast.Name) and
+                node.func.id in aliases))
+        if hit:
+            yield Finding(
+                "HVD004", ctx.relpath, node.lineno, node.col_offset,
+                "raw wall-clock read: timeline and metrics correlate "
+                "through utils.metrics.shared_clock() (monotonic base + "
+                "one epoch anchor). Use shared_clock().ts_us() / "
+                ".epoch_us(); only genuinely cross-process wall-clock "
+                "stamps may stay, with a disable reason.")
+
+
+# ---------------------------------------------------------------------------
+# HVD005 — env-registry drift
+# ---------------------------------------------------------------------------
+
+def _call_name(node):
+    """Last path segment of the callee: f() -> "f", mod.f() -> "f"."""
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _env_reads(tree):
+    """Yield (node, env_name) for every literal HVD_*/HOROVOD_* env
+    access: os.environ get/[]/in/pop/setdefault, os.getenv, and the
+    repo's config-helper calls (env_bool("X") reads HOROVOD_X/HVD_X)."""
+    def literal(arg):
+        return arg.value if isinstance(arg, ast.Constant) and \
+            isinstance(arg.value, str) else None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and len(chain) >= 3 and chain[-2] == "environ" and \
+                    chain[-1] in ("get", "pop", "setdefault") and \
+                    node.args:
+                name = literal(node.args[0])
+                if name and _ENV_NAME_RE.match(name):
+                    yield node, name
+            elif chain and chain[-1] == "getenv" and node.args:
+                name = literal(node.args[0])
+                if name and _ENV_NAME_RE.match(name):
+                    yield node, name
+            elif _call_name(node) in _ENV_HELPERS and node.args:
+                name = literal(node.args[0])
+                if name and not _ENV_NAME_RE.match(name) and \
+                        _ENV_NAME_RE.match("HOROVOD_" + name):
+                    yield node, "HOROVOD_" + name
+            elif _call_name(node) in _ENV_FULLNAME_HELPERS:
+                for arg in node.args:
+                    name = literal(arg)
+                    if name and _ENV_NAME_RE.match(name):
+                        yield node, name
+        elif isinstance(node, ast.Subscript):
+            chain = _attr_chain(node.value)
+            if chain and chain[-1] == "environ":
+                name = literal(node.slice)
+                if name and _ENV_NAME_RE.match(name):
+                    yield node, name
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            chain = _attr_chain(node.comparators[0])
+            if chain and chain[-1] == "environ":
+                name = literal(node.left)
+                if name and _ENV_NAME_RE.match(name):
+                    yield node, name
+
+
+def check_env_registry(ctx, shared):
+    reads = list(_env_reads(ctx.tree))
+    if not reads:
+        return
+    if shared.env_registry_error is not None:
+        yield Finding(
+            "HVD005", ctx.relpath, reads[0][0].lineno,
+            reads[0][0].col_offset,
+            f"cannot load ENV_REGISTRY from "
+            f"{shared.env_registry_path}: {shared.env_registry_error}")
+        return
+    for node, name in reads:
+        if name not in shared.env_lookup:
+            yield Finding(
+                "HVD005", ctx.relpath, node.lineno, node.col_offset,
+                f"env var '{name}' is read here but not registered: add "
+                "it to ENV_REGISTRY in horovod_tpu/common/config.py "
+                "(name, default, owner, description) and regenerate "
+                "docs/envvars.md with `python -m tools.hvdlint "
+                "--emit-envdoc docs/envvars.md`.")
+
+
+# ---------------------------------------------------------------------------
+# HVD006 — swallowed exception
+# ---------------------------------------------------------------------------
+
+def _is_broad(handler_type):
+    if handler_type is None:  # bare except:
+        return True
+    if isinstance(handler_type, ast.Name):
+        return handler_type.id in _BROAD_EXC_NAMES
+    if isinstance(handler_type, ast.Tuple):
+        return any(_is_broad(e) for e in handler_type.elts)
+    return False
+
+
+def check_swallowed_exception(ctx, shared):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        handled = False
+        for sub in ast.walk(ast.Module(body=node.body,
+                                       type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                handled = True
+                break
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if name in _LOG_CALL_NAMES:
+                    handled = True
+                    break
+        if not handled:
+            yield Finding(
+                "HVD006", ctx.relpath, node.lineno, node.col_offset,
+                "broad except that neither re-raises nor logs: on a "
+                "control/data-plane path this turns real faults "
+                "(mismatched collectives, dead peers, corrupt caches) "
+                "into silent divergence. Narrow the exception type, log "
+                "via common.hvd_logging, re-raise — or disable with a "
+                "reason if swallowing is genuinely correct.")
+
+
+# ---------------------------------------------------------------------------
+# HVD007 — jit purity
+# ---------------------------------------------------------------------------
+
+_TRACER_NAMES = {"jit", "pjit", "pmap", "pallas_call", "shard_map"}
+_IMPURE_TIME = {"time", "time_ns", "sleep", "monotonic", "perf_counter"}
+
+
+def _is_tracer_expr(expr):
+    """jax.jit / jit / pl.pallas_call / partial(jax.jit, ...) /
+    jax.jit(...) used as a decorator factory."""
+    chain = _attr_chain(expr)
+    if chain and chain[-1] in _TRACER_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        fchain = _attr_chain(expr.func)
+        if fchain and fchain[-1] in _TRACER_NAMES:
+            return True
+        if fchain and fchain[-1] == "partial" and expr.args:
+            return _is_tracer_expr(expr.args[0])
+    return False
+
+
+def _traced_functions(tree):
+    traced = []
+    # decorated defs
+    for func in _iter_function_defs(tree):
+        if any(_is_tracer_expr(d) for d in func.decorator_list):
+            traced.append(func)
+    # defs/lambdas passed to jit(f) / pallas_call(f) / shard_map(f, ...)
+    local_defs = {}
+    for func in _iter_function_defs(tree):
+        local_defs.setdefault(func.name, func)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fchain = _attr_chain(node.func)
+        if not (fchain and fchain[-1] in _TRACER_NAMES):
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Lambda):
+                traced.append(arg)
+            elif isinstance(arg, ast.Name) and arg.id in local_defs:
+                traced.append(local_defs[arg.id])
+    return traced
+
+
+def check_jit_purity(ctx, shared):
+    seen = set()
+    emitted = set()  # (line, col): os.environ.get() flags once, not as
+    #                  both the Call and its inner Attribute
+    for func in _traced_functions(ctx.tree):
+        if id(func) in seen:
+            continue
+        seen.add(id(func))
+        for node in ast.walk(func):
+            impure = None
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ("print", "input", "open"):
+                    impure = f"{node.func.id}()"
+                elif chain and chain[0] == "time" and len(chain) == 2 \
+                        and chain[1] in _IMPURE_TIME:
+                    impure = f"time.{chain[1]}()"
+                elif chain and chain[0] == "random":
+                    impure = "random.*"
+                elif chain and len(chain) >= 2 and \
+                        chain[0] in ("np", "numpy") and \
+                        chain[1] == "random":
+                    impure = "numpy.random.*"
+                elif chain and len(chain) >= 2 and \
+                        chain[:2] == ["os", "environ"]:
+                    impure = "os.environ"
+            elif isinstance(node, (ast.Subscript, ast.Attribute)):
+                chain = _attr_chain(node if isinstance(
+                    node, ast.Attribute) else node.value)
+                if chain and chain[:2] == ["os", "environ"] and \
+                        len(chain) == 2:
+                    impure = "os.environ"
+            if impure:
+                if (node.lineno, node.col_offset) in emitted:
+                    continue
+                emitted.add((node.lineno, node.col_offset))
+                yield Finding(
+                    "HVD007", ctx.relpath, node.lineno, node.col_offset,
+                    f"Python side effect ({impure}) inside a "
+                    "jit/pjit/pallas-traced function: it runs at TRACE "
+                    "time (once per compilation, not per step) and its "
+                    "value is baked into the compiled graph — silent "
+                    "staleness plus rank divergence if ranks trace at "
+                    "different moments. Hoist it out of the traced "
+                    "function, or use jax.debug.* / io_callback.")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+RULES = {
+    r.code: r for r in [
+        Rule(
+            "HVD001", "rank-divergent-iteration",
+            "unsorted set iteration in a wire module",
+            """HVD001 — rank-divergent iteration
+
+Horovod's core invariant: every rank executes IDENTICAL collectives in
+IDENTICAL order (Sergeev & Del Balso, arXiv:1802.05799 §3). Python set
+iteration order depends on per-process hash randomization, so a set
+iterated without sorted() on any path that feeds a cross-rank message
+(CycleRequest entry order, CycleResponse plans, fusion buckets) produces
+a different schedule on every rank — a hang or silent numeric corruption
+that only reproduces under PYTHONHASHSEED variation.
+
+History: the negotiation re-announce path (ops/eager.py) and the
+coordinator's lost-rank list (ops/negotiation.py) both iterate sets that
+ride the wire; each carries the sorted() this rule now enforces.
+Deleting either sorted() makes this rule fail CI — by design.
+
+Scope: modules with the 'wire' role (see rules.py / `# hvdlint:
+role=wire`). Fix: wrap the iterable in sorted().""",
+            check_rank_divergence),
+        Rule(
+            "HVD002", "lock-order-deadlock",
+            "self-deadlock or inconsistent lock order",
+            """HVD002 — lock order / self-deadlock
+
+Flags three shapes, all statically decidable within one module:
+(1) re-acquiring a non-reentrant threading.Lock already held in the
+same function; (2) calling, while holding lock L, a same-module
+function/method that (transitively) acquires L again; (3) two code
+paths nesting locks A->B and B->A.
+
+History: the telemetry PR's metrics-registry reset() held the module
+_registry_lock and then called get_registry(), which takes the same
+lock — a guaranteed self-deadlock, shipped and then hot-fixed (shape 2).
+Re-introducing that pattern makes this rule fail CI.
+
+Fix: release before calling, restructure into an _unlocked helper, or
+use an RLock when re-entrancy is the intended design.""",
+            check_lock_order),
+        Rule(
+            "HVD003", "blocking-call-in-coordinator-loop",
+            "unbounded blocking call at cycle cadence",
+            """HVD003 — blocking call in the coordinator loop
+
+The negotiation cycle runs every ~5 ms on every rank; the coordinator's
+handler runs inside request handling. Any unbounded blocking call there
+(sleep >= 1 s, connect/recv with no timeout, argless .wait()/.join(),
+synchronous file I/O, subprocess without timeout) freezes the control
+plane for EVERY rank: stall detection, liveness escalation and shutdown
+drains all ride this loop (MPI progress hazards: arXiv:1810.11112).
+
+Scope: modules with the 'loop' role. Fix: pass a timeout, pace sleeps
+by the cycle time, or queue the work to a side thread (the
+utils/timeline.py writer-thread pattern).""",
+            check_blocking_in_loop),
+        Rule(
+            "HVD004", "raw-clock",
+            "time.time() instead of the shared Clock",
+            """HVD004 — raw wall clock
+
+Timeline traces and metrics events correlate instant-for-instant only
+because both stamp from ONE shared monotonic clock with one wall-clock
+epoch anchor (utils.metrics.shared_clock; the Timeline adopts it and
+writes the pairing as its clock_sync event). A raw time.time() read is
+(a) un-correlatable with those streams and (b) not monotonic — NTP
+steps make deadlines computed from it jump.
+
+History: 7 raw time.time() sites predated this rule; the launcher
+Timeout helper now rides the shared clock, and the genuinely
+cross-process wall-clock stamps (mpirun rendezvous freshness, the
+disk-cache TTL, and the Clock's own epoch anchor) are baselined with
+reasons in tools/hvdlint/baseline.json.
+
+Fix: shared_clock().ts_us() for durations/deadlines,
+shared_clock().epoch_us() for wall-ish stamps; baseline only stamps
+that must compare across processes/restarts.""",
+            check_raw_clock),
+        Rule(
+            "HVD005", "env-registry-drift",
+            "HVD_*/HOROVOD_* read missing from ENV_REGISTRY",
+            """HVD005 — env-registry drift
+
+Every HVD_*/HOROVOD_* environment variable is an API surface: ranks
+must agree on it, operators must be able to discover it, and drift
+between code and docs is how knobs become folklore. The single source
+of truth is ENV_REGISTRY in horovod_tpu/common/config.py (a pure
+literal, parsed — never imported — by this rule); docs/envvars.md is
+generated from it (`--emit-envdoc`) and CI fails if the doc drifts
+(`--check-envdoc`).
+
+This rule flags any literal env read (os.environ get/[]/in/pop/
+setdefault, os.getenv, the config helpers env_bool/env_int/env_float/
+env_str/_env, and _env_first) whose variable is not registered.
+
+Fix: add a registry entry (name, aliased, default, owner, description)
+and regenerate docs/envvars.md.""",
+            check_env_registry),
+        Rule(
+            "HVD006", "swallowed-exception",
+            "broad except that neither raises nor logs",
+            """HVD006 — swallowed exception
+
+`except Exception: pass` on a control/data-plane path converts real
+faults — mismatched collectives, dead peers, corrupt rendezvous state —
+into silent divergence that surfaces ranks later as a hang. The rule
+flags any handler catching Exception/BaseException/bare whose body
+neither raises, nor logs (common.hvd_logging / logging / warnings /
+traceback.print_exc), nor records a metrics event.
+
+History: the chaos PR found the lost-response unknown_ids dedupe bug
+hiding behind exactly this shape; several probing helpers
+(`_bound_axis_names`, jax-internal lookups) also swallowed
+ImportError-class probes with Exception breadth — those are now
+narrowed to (ImportError, AttributeError).
+
+Fix: narrow the type to what the probe can actually raise, log it, or
+re-raise; disable with a reason only where swallowing is the contract
+(e.g. best-effort teardown of an already-failed peer).""",
+            check_swallowed_exception),
+        Rule(
+            "HVD007", "jit-purity",
+            "Python side effect inside a traced function",
+            """HVD007 — jit purity
+
+A function under jax.jit/pjit/pmap/shard_map/pallas_call executes its
+Python body at TRACE time only. A print fires once per compilation; an
+os.environ or time.time() read is frozen into the compiled graph — and
+because ranks may trace at different moments (or hit different caches),
+a trace-time read of mutable process state is also a rank-divergence
+hazard: two ranks can bake DIFFERENT constants into the "same"
+collective program.
+
+Flags print/input/open, os.environ access, time.* reads/sleeps, and
+random/np.random calls lexically inside traced functions.
+
+Fix: hoist the read out and pass it as an argument (static or traced),
+or use jax.debug.print / jax.experimental.io_callback for intentional
+runtime effects.""",
+            check_jit_purity),
+    ]
+}
