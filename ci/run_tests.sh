@@ -10,7 +10,8 @@ echo "--- hvdlint (fastest gate: distributed-correctness static analysis)"
 # Dependency-free stdlib-ast lint, seconds not minutes, so it runs before
 # anything that compiles or spawns. Catches rank-divergent iteration,
 # lock-order deadlocks, raw clocks, env-registry drift, swallowed
-# exceptions and jit impurity statically (docs/hvdlint.md); then verifies
+# exceptions, jit impurity and leaked tracing spans statically
+# (docs/hvdlint.md); then verifies
 # docs/envvars.md still matches ENV_REGISTRY.
 python -m tools.hvdlint horovod_tpu tools bench.py
 python -m tools.hvdlint --check-envdoc
@@ -36,6 +37,13 @@ echo "--- metrics (fast fail: telemetry registry, aggregation, stall gauges)"
 # renderer/parser with no network.
 python -m pytest tests/test_metrics.py tests/test_stall.py -q -m "not slow"
 python tools/hvd_top.py --selftest
+
+echo "--- tracing (fast fail: span model, flight recorder, postmortem merge)"
+# The tracing plane is the postmortem story for every failure the rest
+# of the suite can produce; its unit tests (span lifecycle, ring bounds,
+# dump format, cross-rank merge math) are process-local and cheap, so a
+# broken flight recorder fails CI before the expensive drills run.
+python -m pytest tests/test_tracing.py -q -m "not slow"
 
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is

@@ -188,6 +188,15 @@ ENV_REGISTRY = (
      "contact (0 disables)."),
     ("HOROVOD_CYCLE_TIME", True, "5.0", "common/config.py",
      "Negotiation cycle time in milliseconds."),
+    ("HOROVOD_FLIGHT_CYCLES", True, "64", "utils/tracing.py",
+     "Flight-recorder ring size for negotiation-cycle records."),
+    ("HOROVOD_FLIGHT_DIR", True, None, "utils/tracing.py",
+     "Directory flight-recorder dumps are written to (default: "
+     "<tmp>/hvd-flight)."),
+    ("HOROVOD_FLIGHT_SIGTERM", True, "1", "utils/tracing.py",
+     "Set 0 to skip installing the SIGTERM flight-dump handler."),
+    ("HOROVOD_FLIGHT_SPANS", True, "2048", "utils/tracing.py",
+     "Flight-recorder ring size for finished spans."),
     ("HOROVOD_FUSION_THRESHOLD", True, "67108864", "common/config.py",
      "Fusion-buffer byte threshold for bucketing collectives."),
     ("HOROVOD_HIERARCHICAL_ALLGATHER", True, "0", "common/config.py",
@@ -224,6 +233,12 @@ ENV_REGISTRY = (
      "Write a Chrome-trace timeline to this file."),
     ("HOROVOD_TIMELINE_MARK_CYCLES", True, "0", "common/config.py",
      "Mark negotiation cycles in the timeline."),
+    ("HOROVOD_TRACE", True, "1", "utils/tracing.py",
+     "Set 0 to replace the tracing plane (spans + flight recorder) "
+     "with no-ops."),
+    ("HOROVOD_TRACE_SLOW_MS", True, "100.0", "utils/tracing.py",
+     "Spans slower than this emit a slow_span event into the metrics "
+     "ring."),
     # -- launcher / rendezvous (exact names) ---------------------------
     ("HOROVOD_SECRET_KEY", False, None, "run/cli.py",
      "Base64 HMAC key for the run service; generated per job when "
@@ -290,6 +305,8 @@ ENV_REGISTRY = (
      "Force per-op profile legs on (1) or off (0) in bench.py."),
     ("HVD_BENCH_FLASH_ABLATION", False, None, "bench.py",
      "Force the flash-attention ablation legs on (1) or off (0)."),
+    ("HVD_BENCH_FLIGHT", False, None, "bench.py",
+     "Set 0 to skip the flight-recorder overhead gate in bench.py."),
     ("HVD_TEST_WORKERS", False, "auto", "ci/run_tests.sh",
      "pytest-xdist worker count for the CI suite."),
 )

@@ -56,13 +56,18 @@ class RanksLostError(ShutdownError):
     # supervisor keys auto-shrink on exactly this code
     EXIT_CODE = 44
 
-    def __init__(self, ranks, reason=None):
+    def __init__(self, ranks, reason=None, trace_id=None):
         self.ranks = tuple(sorted({int(r) for r in ranks}))
+        # trace id of the blocking tensor (utils/tracing.py) so the error
+        # message alone is enough to find the span in a flight dump
+        self.trace_id = trace_id
         msg = (f"Horovod ranks {list(self.ranks)} are lost: no "
                f"control-plane heartbeat within the deadline. Pending "
                f"collectives cannot complete and have been failed.")
         if reason:
             msg += f" ({reason})"
+        if trace_id:
+            msg += f" [trace {trace_id}]"
         # bypass ShutdownError.__init__'s canned message
         super(ShutdownError, self).__init__(msg)
 

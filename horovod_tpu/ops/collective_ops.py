@@ -30,6 +30,7 @@ from jax import lax
 
 from ..common import state as state_mod
 from ..utils import metrics as hvd_metrics
+from ..utils import tracing as hvd_tracing
 from .compression import Compression
 
 # Reduction op names, parity with horovod's average flag plus explicit ops.
@@ -126,6 +127,13 @@ def _count_traced(op, tensors):
         "Bytes passed through traced (jit-path) collectives, counted "
         "at trace time, by op class.", labels=("op",)).labels(
         op=op).inc(nbytes)
+    # flight-recorder breadcrumb: retraces landing right before a failure
+    # are a classic divergence cause (shape drift on one rank), so the
+    # trace-time pass leaves a cycle record the postmortem can line up
+    # against the negotiation history
+    hvd_tracing.get_tracer().record_cycle(
+        kind="traced_collective", op=op, n_tensors=len(tensors),
+        nbytes=nbytes)
 
 def allreduce_traced(tensor, average=True, axis_name=None, op=None,
                      compression=Compression.none):

@@ -59,6 +59,7 @@ from ..common.exceptions import (DuplicateNameError, MismatchError,
                                  StalledError)
 from ..utils import metrics as hvd_metrics
 from ..utils import timeline as timeline_mod
+from ..utils import tracing as hvd_tracing
 
 ALLREDUCE = "allreduce"
 ALLGATHER = "allgather"
@@ -79,7 +80,7 @@ class TensorTableEntry:
 
     __slots__ = ("name", "op", "tensor", "root_rank", "average", "kind",
                  "handle", "result", "status", "event", "enqueue_time",
-                 "prescale", "postscale")
+                 "prescale", "postscale", "trace_id", "span")
 
     def __init__(self, name, op, tensor, root_rank=0, average=False,
                  kind="replicated", handle=None):
@@ -94,6 +95,11 @@ class TensorTableEntry:
         self.status = None  # None = pending, True = ok, Exception = error
         self.event = threading.Event()
         self.enqueue_time = time.monotonic()
+        # tracing plane (utils/tracing.py): the tensor's trace id and its
+        # open negotiation-wait span, closed when the coordinator orders
+        # execution (or aborted on the failure paths)
+        self.trace_id = None
+        self.span = None
 
     def signature(self):
         if self.kind == "list":
@@ -313,6 +319,17 @@ class EagerCoordinator:
         reg = self._metrics = hvd_metrics.get_registry()
         if reg.enabled and reg.rank is None:
             reg.rank = jax.process_index()
+        # Tracing plane (utils/tracing.py): per-tensor lifecycle spans and
+        # the always-on flight recorder. The recorder auto-dumps from the
+        # failure paths below; the SIGTERM hook catches external kills.
+        self._tracer = hvd_tracing.get_tracer()
+        hvd_tracing.set_rank(jax.process_index())
+        hvd_tracing.install_signal_dump()
+        # dump-solicitation protocol: the coordinator sets dump_requested
+        # on CycleResponses when it escalates; this worker attaches ONE
+        # flight snapshot to its next CycleRequest in reply
+        self._flight_send_pending = False
+        self._flight_sent = False
         self._m_neg_cycles = reg.counter(
             "hvd_negotiation_cycles_total",
             "Negotiation cycle RPCs completed by this worker.")
@@ -378,13 +395,24 @@ class EagerCoordinator:
         # tensor's semantics (e.g. sparse values whose nnz happens to equal
         # the world size must not be reinterpreted as stacked).
         entry_kind = kind if kind is not None else self._classify(tensor)
-        with self._queue_lock:
-            if name in self._tensor_table:
-                raise DuplicateNameError(name)
-            entry = TensorTableEntry(name, op, tensor, root_rank=root_rank,
-                                     average=average, kind=entry_kind)
-            self._tensor_table[name] = entry
-            self._queue.append(entry)
+        trace_id = self._tracer.new_trace_id(name)
+        with self._tracer.span(hvd_tracing.ENQUEUE, tensor=name,
+                               trace_id=trace_id, op=op, kind=entry_kind):
+            with self._queue_lock:
+                if name in self._tensor_table:
+                    raise DuplicateNameError(name)
+                entry = TensorTableEntry(name, op, tensor,
+                                         root_rank=root_rank,
+                                         average=average, kind=entry_kind)
+                entry.trace_id = trace_id
+                # the negotiation-wait span stays open until the
+                # coordinator orders execution (_apply_cycle_response) or
+                # the queue drains locally (non-negotiated flush)
+                entry.span = self._tracer.span(
+                    hvd_tracing.NEGOTIATE, tensor=name, trace_id=trace_id,
+                    op=op, enqueue_req=self._cycle_req_id)
+                self._tensor_table[name] = entry
+                self._queue.append(entry)
         handle = self.handles.allocate(entry)
         if self.timeline:
             self.timeline.negotiate_start(name, op)
@@ -488,6 +516,10 @@ class EagerCoordinator:
             self.timeline.mark_cycle_start()
             for e in batch:
                 self.timeline.negotiate_end(e.name)
+        for e in batch:
+            # single-process: negotiation is a local queue wait
+            if e.span is not None:
+                e.span.close(local=True)
         t0 = time.perf_counter()
         # the plan depends on the (possibly autotuned) fusion threshold
         key = (int(self._config.fusion_threshold),
@@ -569,6 +601,10 @@ class EagerCoordinator:
         for kind, idxs, average in plan:
             entries = [batch[i] for i in idxs]
             t0 = time.perf_counter()
+            lead = entries[0]
+            ex_span = self._tracer.span(
+                hvd_tracing.EXECUTE, tensor=lead.name,
+                trace_id=lead.trace_id, op=lead.op, fused=len(entries))
             try:
                 if kind == "fused_allreduce":
                     self._exec_fused_stacked_allreduce(entries, average)
@@ -578,19 +614,25 @@ class EagerCoordinator:
                 for e in entries:
                     e.status = True
                 op_class = entries[0].op
-                self._m_coll_bytes.labels(op=op_class).inc(
-                    sum(_entry_nbytes(e) for e in entries))
+                nbytes = sum(_entry_nbytes(e) for e in entries)
+                self._m_coll_bytes.labels(op=op_class).inc(nbytes)
                 self._m_coll_s.labels(op=op_class).observe(
                     time.perf_counter() - t0)
+                ex_span.close(bytes=nbytes)
             # hvdlint: disable=HVD006(status carries the fault to every waiter)
             except Exception as exc:
+                ex_span.abort(exc)
                 for e in entries:
                     e.status = exc
             finally:
-                with self._queue_lock:
-                    for e in entries:
-                        self._tensor_table.pop(e.name, None)
-                        e.event.set()
+                with self._tracer.span(
+                        hvd_tracing.CALLBACK, tensor=lead.name,
+                        trace_id=lead.trace_id, parent=ex_span,
+                        n_tensors=len(entries)):
+                    with self._queue_lock:
+                        for e in entries:
+                            self._tensor_table.pop(e.name, None)
+                            e.event.set()
 
     # -- negotiated multi-process cycle (RunLoopOnce's coordinator
     # protocol, operations.cc:1246-1551, over the TCP control plane) --
@@ -631,6 +673,8 @@ class EagerCoordinator:
                 if e.kind == "list":  # local-only op: no cross-process leg
                     if self.timeline:
                         self.timeline.negotiate_end(e.name)
+                    if e.span is not None:
+                        e.span.close(local=True)
                     self._finish_entries([e], lambda es: self._exec_single(
                         es[0], es[0].op, "list"))
                     continue
@@ -640,12 +684,16 @@ class EagerCoordinator:
                     if cached[1] == e.signature():
                         hit_ids.append(cached[0])  # steady-state bypass
                         self._neg_hit_count += 1
+                        if e.span is not None:
+                            e.span.annotate(cache_hit=True)
                         continue
                     # signature changed: full meta (which also makes the
                     # coordinator invalidate the id for every peer)
                     del self._neg_cache[e.name]
                     self._neg_cache_ids.pop(cached[0], None)
                 metas.append(self._meta_of(e, neg))
+                if e.span is not None:
+                    e.span.annotate(cache_hit=False)
             # names whose cache ids came back unknown (evicted or
             # invalidated at the coordinator): re-announce in full
             for name in sorted(self._reannounce):
@@ -663,12 +711,19 @@ class EagerCoordinator:
                 self._metrics_next_push = now + (
                     getattr(self._config, "metrics_interval", 5.0) or 5.0)
                 push = self._metrics.snapshot(max_events=32)
+        # dump solicitation: the coordinator asked for this worker's
+        # flight recorder (dump_requested flag on a prior response) —
+        # attach one snapshot and clear the request
+        flight = None
+        if self._flight_send_pending:
+            self._flight_send_pending = False
+            flight = self._tracer.flight_snapshot("coordinator_request")
         t0 = time.perf_counter()
         try:
             resp = self._negotiator.cycle(metas, self._applied_seq,
                                           req_id=self._cycle_req_id,
                                           hits=neg.encode_hits(hit_ids),
-                                          metrics=push)
+                                          metrics=push, flight=flight)
         # hvdlint: disable=HVD006(retried next cycle; counted in hvd_negotiation_failures and escalated by liveness fail-fast)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = (metas, hit_ids)
@@ -694,9 +749,18 @@ class EagerCoordinator:
                 # RanksLostError: the coordinator IS rank 0's process, so
                 # losing the plane is losing rank 0 — supervisors key
                 # their auto-shrink on this type's exit code.
+                # first-class telemetry before the dump: the flight
+                # recorder snapshots the event ring, so the postmortem
+                # sees this rank's own verdict alongside its open spans
+                self._metrics.event(
+                    "ranks_lost", ranks=[0],
+                    reason="control plane unreachable",
+                    trace_id=self._blocking_trace_id())
+                self._tracer.dump("coordinator_lost")
                 self._fail_pending_negotiated(RanksLostError(
                     [0], reason="negotiation control plane unreachable: "
-                                f"{exc}"))
+                                f"{exc}",
+                    trace_id=self._blocking_trace_id()))
                 self._unannounced = None
                 self._negotiation_dead = True
                 try:
@@ -710,6 +774,14 @@ class EagerCoordinator:
             return
         self._m_neg_cycles.inc()
         self._m_neg_cycle_s.observe(time.perf_counter() - t0)
+        self._tracer.record_cycle(
+            req_id=self._cycle_req_id, ack=self._applied_seq,
+            n_metas=len(metas), n_hits=len(hit_ids),
+            rtt_ms=(time.perf_counter() - t0) * 1000.0)
+        if getattr(resp, "dump_requested", False) and not self._flight_sent:
+            self._flight_sent = True
+            self._flight_send_pending = True
+            self._tracer.dump("coordinator_request")
         self._unannounced = None
         self._cycle_failures = 0
         self._cycle_fail_since = None
@@ -743,23 +815,33 @@ class EagerCoordinator:
         """Run exec_fn over entries, then complete them (status, table
         removal, event) — the bookkeeping half of _execute."""
         t0 = time.perf_counter()
+        lead = entries[0]
+        ex_span = self._tracer.span(
+            hvd_tracing.EXECUTE, tensor=lead.name, trace_id=lead.trace_id,
+            op=lead.op, fused=len(entries))
         try:
             exec_fn(entries)
             for e in entries:
                 e.status = True
             op = entries[0].op
-            self._m_coll_bytes.labels(op=op).inc(
-                sum(_entry_nbytes(e) for e in entries))
+            nbytes = sum(_entry_nbytes(e) for e in entries)
+            self._m_coll_bytes.labels(op=op).inc(nbytes)
             self._m_coll_s.labels(op=op).observe(time.perf_counter() - t0)
+            ex_span.close(bytes=nbytes)
         # hvdlint: disable=HVD006(status carries the fault to every waiter)
         except Exception as exc:  # noqa: BLE001 — status carries it
+            ex_span.abort(exc)
             for e in entries:
                 e.status = exc
         finally:
-            with self._queue_lock:
-                for e in entries:
-                    self._tensor_table.pop(e.name, None)
-                    e.event.set()
+            with self._tracer.span(
+                    hvd_tracing.CALLBACK, tensor=lead.name,
+                    trace_id=lead.trace_id, parent=ex_span,
+                    n_tensors=len(entries)):
+                with self._queue_lock:
+                    for e in entries:
+                        self._tensor_table.pop(e.name, None)
+                        e.event.set()
 
     def _apply_cycle_response(self, resp):
         """Apply coordinator responses strictly in seq order; returns the
@@ -770,8 +852,10 @@ class EagerCoordinator:
             # dead — pending work can never complete, so fail it all
             # within one cycle of the declaration instead of hanging
             from . import negotiation as neg
-            neg.raise_if_ranks_lost(resp)
+            neg.raise_if_ranks_lost(resp,
+                                    trace_id=self._blocking_trace_id())
         except RanksLostError as exc:
+            self._tracer.dump("ranks_lost")
             self._fail_pending_negotiated(exc)
             self._negotiation_dead = True
             return 0
@@ -781,6 +865,7 @@ class EagerCoordinator:
             # are unrecoverable, so pending work must fail, not hang —
             # and the peers must hear shutdown, or their matching
             # collectives (and never-completing table rows) hang forever
+            self._tracer.dump("stale_ack")
             self._fail_pending_negotiated(ShutdownError(
                 "negotiation response log overflow: this rank fell "
                 "behind the coordinator's retained window"))
@@ -813,6 +898,8 @@ class EagerCoordinator:
                     f"control-plane state diverged: coordinator ordered "
                     f"{r.names} but {missing} are not pending here")
                 for e in entries:
+                    if e.span is not None:
+                        e.span.abort(exc)
                     e.status = exc
                 with self._queue_lock:
                     for e in entries:
@@ -824,6 +911,19 @@ class EagerCoordinator:
             if self.timeline:
                 for e in entries:
                     self.timeline.negotiate_end(e.name)
+            for e in entries:
+                # close the negotiation-wait span: the coordinator has
+                # ordered this tensor (or errored it). ``cycle`` (=seq) is
+                # globally consistent, so it is the cross-rank stitch key.
+                if e.span is None:
+                    continue
+                if r.kind == r.ERROR:
+                    e.span.abort(r.error)
+                else:
+                    waited = self._cycle_req_id - int(
+                        e.span.attrs.get("enqueue_req",
+                                         self._cycle_req_id))
+                    e.span.close(cycle=seq, cycles_waited=waited)
             if r.kind == r.EXECUTE and getattr(r, "cache_ids", None):
                 # learn coordinator-assigned cache ids; riding the
                 # seq-ordered log makes every rank's mapping identical
@@ -885,8 +985,19 @@ class EagerCoordinator:
             for e in pending:
                 self._tensor_table.pop(e.name, None)
         for e in pending:
+            if e.span is not None:
+                e.span.abort(exc)
             e.status = exc
             e.event.set()
+
+    def _blocking_trace_id(self):
+        """Trace id of the oldest tensor still waiting on negotiation —
+        the one a RanksLostError names so the flight dump can be read
+        starting from the span that was actually blocked."""
+        for e in self._negotiated_pending.values():
+            if e.trace_id:
+                return e.trace_id
+        return None
 
     @functools.cached_property
     def _proc_engine(self):
@@ -1395,10 +1506,13 @@ class EagerCoordinator:
         self._m_stalled_tensors.set(len(stalled))
         new = [e for e in stalled if e.name not in self._stall_warned]
         if new:
-            names = ", ".join(e.name for e in new)
+            names = ", ".join(
+                f"{e.name} [trace {e.trace_id}]" if e.trace_id else e.name
+                for e in new)
             self._metrics.event(
                 "stall", tensors=sorted(e.name for e in new),
-                deadline_s=warn)
+                deadline_s=warn,
+                trace_ids=sorted(e.trace_id for e in new if e.trace_id))
             log.warning(
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for "
@@ -1410,10 +1524,14 @@ class EagerCoordinator:
                 self._m_stall_kills.inc(len(dead))
                 self._metrics.event(
                     "stall_kill", tensors=sorted(e.name for e in dead),
-                    deadline_s=kill)
+                    deadline_s=kill,
+                    trace_ids=sorted(e.trace_id for e in dead
+                                     if e.trace_id))
+                self._tracer.dump("stall_kill")
                 exc = StalledError(
                     f"Collectives stalled past shutdown deadline: "
-                    f"{', '.join(e.name for e in dead)}")
+                    f"{', '.join(e.name for e in dead)} (traces: "
+                    f"{', '.join(e.trace_id or '?' for e in dead)})")
                 with self._queue_lock:
                     for e in dead:
                         self._tensor_table.pop(e.name, None)
@@ -1422,6 +1540,8 @@ class EagerCoordinator:
                         except ValueError:
                             pass
                 for e in dead:
+                    if e.span is not None:
+                        e.span.abort(exc)
                     e.status = exc
                     e.event.set()
 
@@ -1463,6 +1583,8 @@ class EagerCoordinator:
             self._negotiated_pending.clear()
         exc = ShutdownError()
         for e in pending:
+            if e.span is not None:
+                e.span.abort(exc)
             e.status = exc
             e.event.set()
         if self._metrics_server is not None:

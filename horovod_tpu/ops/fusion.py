@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import metrics as hvd_metrics
+from ..utils import tracing as hvd_tracing
 
 
 @dataclasses.dataclass
@@ -50,21 +51,27 @@ def plan_buckets(leaves, fusion_threshold):
     """
     if fusion_threshold is None:
         fusion_threshold = 0
-    sizes = [_nbytes(leaf) for leaf in leaves]
-    dtypes = [leaf.dtype for leaf in leaves]
-    assignment = _native_plan(sizes, dtypes, int(fusion_threshold))
-    if assignment is None:
-        assignment = _python_plan(sizes, dtypes, int(fusion_threshold))
-    buckets = {}
-    order = []
-    for i, bid in enumerate(assignment):
-        b = buckets.get(bid)
-        if b is None:
-            b = Bucket([], dtypes[i], 0)
-            buckets[bid] = b
-            order.append(b)
-        b.indices.append(i)
-        b.nbytes += sizes[i]
+    # fusion-placement span: one per planning call, on whichever side
+    # plans (the coordinator under negotiation, the local flush without)
+    with hvd_tracing.get_tracer().span(
+            hvd_tracing.FUSION, n_tensors=len(leaves),
+            threshold=int(fusion_threshold)) as fspan:
+        sizes = [_nbytes(leaf) for leaf in leaves]
+        dtypes = [leaf.dtype for leaf in leaves]
+        assignment = _native_plan(sizes, dtypes, int(fusion_threshold))
+        if assignment is None:
+            assignment = _python_plan(sizes, dtypes, int(fusion_threshold))
+        buckets = {}
+        order = []
+        for i, bid in enumerate(assignment):
+            b = buckets.get(bid)
+            if b is None:
+                b = Bucket([], dtypes[i], 0)
+                buckets[bid] = b
+                order.append(b)
+            b.indices.append(i)
+            b.nbytes += sizes[i]
+        fspan.annotate(n_buckets=len(order), bytes=sum(sizes))
     # fusion-buffer utilization telemetry: the fill fraction of each
     # planned bucket against the live threshold is the signal the
     # autotuner (and an operator at hvd_top) reads to judge whether the

@@ -39,6 +39,7 @@ from ..common import hvd_logging as log
 from ..common.exceptions import RanksLostError
 from ..run import network, secret
 from ..utils import metrics as hvd_metrics
+from ..utils import tracing as hvd_tracing
 
 # ops (mirrors eager.py's constants; import cycle keeps them local)
 ALLREDUCE = "allreduce"
@@ -207,7 +208,8 @@ def encode_response(resp):
     out = bytearray()
     out.append(RESPONSE_WIRE_VERSION)
     _put_varint(out, resp.base_seq)
-    out.append((1 if resp.shutdown else 0) | (2 if resp.stale_ack else 0))
+    out.append((1 if resp.shutdown else 0) | (2 if resp.stale_ack else 0)
+               | (4 if resp.dump_requested else 0))
     thr, cyc = resp.params
     _put_varint(out, int(thr))
     out.extend(struct.pack("<d", float(cyc)))
@@ -305,16 +307,23 @@ def decode_response(payload):
                                             cache_ids=cache_ids))
     return CycleResponse(base_seq, responses, (thr, cyc), bool(flags & 1),
                          stale_ack=bool(flags & 2),
+                         dump_requested=bool(flags & 4),
                          unknown_ids=unknown_ids, lost_ranks=lost_ranks)
 
 
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
-                 hits=b"", metrics=None):
+                 hits=b"", metrics=None, flight=None):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
         self.shutdown = shutdown
+        # flight-recorder piggyback (utils/tracing.py): when the previous
+        # CycleResponse carried dump_requested, the worker attaches its
+        # flight snapshot here (once) so the coordinator can persist every
+        # rank's last seconds even for ranks whose disks are unreachable.
+        # None on every normal cycle — same pattern as `metrics` below.
+        self.flight = flight
         # low-rate piggyback: every HVD_METRICS_INTERVAL seconds the
         # worker attaches its metrics snapshot (utils/metrics.py) here,
         # making the negotiation cycle the aggregation transport — no
@@ -353,7 +362,8 @@ class NegotiatedResponse:
 
 class CycleResponse:
     def __init__(self, base_seq, responses, params, shutdown,
-                 stale_ack=False, unknown_ids=(), lost_ranks=()):
+                 stale_ack=False, dump_requested=False, unknown_ids=(),
+                 lost_ranks=()):
         self.base_seq = base_seq      # seq of responses[0]
         self.responses = responses    # list[NegotiatedResponse]
         self.params = params          # (fusion_threshold, cycle_time_ms)
@@ -362,6 +372,11 @@ class CycleResponse:
         # never catch up and must fail its pending work (see
         # _prune_acknowledged's cap)
         self.stale_ack = stale_ack
+        # the coordinator is soliciting a flight-recorder dump (stall or
+        # liveness escalation): the worker attaches its flight snapshot
+        # to the next CycleRequest. An optional flag bit old decoders
+        # ignore — same RESPONSE_WIRE_VERSION.
+        self.dump_requested = dump_requested
         # cache ids the requester announced as hits that this coordinator
         # does not hold (evicted, or invalidated by another rank's
         # changed-signature resubmission): the worker drops its mapping
@@ -451,6 +466,13 @@ class CoordinatorService(network.BasicService):
         # plus the coordinator-side instruments (bound once here — the
         # per-cycle cost in _handle is an inc/observe, not a lookup)
         self.metrics_snapshots = {}
+        # tracing plane: stall/liveness escalation flips _dump_requested,
+        # every subsequent CycleResponse carries the flag, and each
+        # worker's next cycle piggybacks its flight snapshot — persisted
+        # here (rank -> dump path) by utils/tracing.write_remote_dump
+        self._tracer = hvd_tracing.get_tracer()
+        self._dump_requested = False
+        self.flight_dumps = {}
         reg = self._metrics = hvd_metrics.get_registry()
         self._m_cycles = reg.counter(
             "hvd_coordinator_cycles_total",
@@ -506,6 +528,11 @@ class CoordinatorService(network.BasicService):
                 self._m_cycles.inc()
                 if req.metrics is not None:
                     self.metrics_snapshots[req.rank] = req.metrics
+                if req.flight is not None:
+                    path = hvd_tracing.write_remote_dump(
+                        req.flight, rank=req.rank)
+                    if path is not None:
+                        self.flight_dumps[req.rank] = path
                 self._last_seen[req.rank] = time.monotonic()
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
@@ -556,6 +583,14 @@ class CoordinatorService(network.BasicService):
                     self._shutdown = True
                 self._stall_scan()
                 self._prune_acknowledged()
+                # coordinator-side cycle record: the postmortem's "last N
+                # cycles" view — one dict append, no span overhead on the
+                # per-request hot path
+                self._tracer.record_cycle(
+                    rank=req.rank, req_id=req.req_id, ack=req.ack,
+                    n_metas=len(req.entries),
+                    seq=self._base_seq + len(self._responses) - 1,
+                    shutdown=bool(req.shutdown))
                 stale = req.ack + 1 < self._base_seq
                 start = max(0, req.ack + 1 - self._base_seq)
                 return CycleResponse(
@@ -563,6 +598,7 @@ class CoordinatorService(network.BasicService):
                     (self._config.fusion_threshold,
                      self._config.cycle_time_ms),
                     self._shutdown, stale_ack=stale,
+                    dump_requested=self._dump_requested,
                     unknown_ids=unknown,
                     lost_ranks=sorted(self._lost_ranks))
         raise NotImplementedError(req)
@@ -759,14 +795,25 @@ class CoordinatorService(network.BasicService):
             stalled_tensors += 1
             if not row.warned:
                 row.warned = True
+                # rank 0 hosts a worker too, so its tracer knows the
+                # blocking tensor's trace id — stall telemetry names the
+                # exact trace to pull from a flight dump
+                trace_id = self._tracer.trace_id_for(name)
                 self._metrics.event(
                     "stall", tensor=name, missing_ranks=missing,
-                    waited_s=round(now - row.first_ts, 3))
+                    waited_s=round(now - row.first_ts, 3),
+                    trace_id=trace_id)
                 log.warning(
                     "One or more tensors were submitted to be reduced, "
                     "gathered or broadcasted by subset of ranks and are "
                     "waiting for remainder of ranks for more than %ss: "
-                    "%s (missing ranks: %s)", warn, name, missing)
+                    "%s (missing ranks: %s, trace %s)", warn, name,
+                    missing, trace_id)
+        if stalled_tensors and not self._dump_requested:
+            # stall escalation: start soliciting flight dumps so the
+            # postmortem has every rank's view even if nothing dies
+            self._dump_requested = True
+            self._tracer.dump("stall")
         self._m_stalled_ranks.set(len(stalled_ranks))
         self._m_stalled_pending.set(stalled_tensors)
 
@@ -796,7 +843,13 @@ class CoordinatorService(network.BasicService):
         self._m_lost_ranks.set(len(dead))
         self._metrics.event(
             "ranks_lost", ranks=dead, deadline_s=deadline,
-            failed_tensors=len(self._order))
+            failed_tensors=len(self._order),
+            trace_ids={n: self._tracer.trace_id_for(n)
+                       for n in self._order[:8]})
+        # terminal escalation: dump our own flight ring and solicit every
+        # surviving rank's on their next cycle
+        self._dump_requested = True
+        self._tracer.dump("ranks_lost")
         log.error(
             "negotiation liveness: ranks %s sent no cycle for more than "
             "%ss — declaring them LOST and failing all pending work "
@@ -807,23 +860,27 @@ class CoordinatorService(network.BasicService):
         for name in self._order:
             row = self._table.pop(name)
             op = next(iter(row.metas.values())).op
+            tid = self._tracer.trace_id_for(name)
+            suffix = f" [trace {tid}]" if tid else ""
             self._responses.append(NegotiatedResponse(
                 NegotiatedResponse.ERROR, op, [name],
                 error=f"RanksLostError: {op} '{name}' cannot complete: "
-                      f"{reason}."))
+                      f"{reason}.{suffix}"))
         self._order = []
 
 
-def raise_if_ranks_lost(resp):
+def raise_if_ranks_lost(resp, trace_id=None):
     """The worker half of the liveness protocol: fail fast when the
     coordinator declared ranks dead. Shared by the eager engine
     (_apply_cycle_response) and the protocol-level chaos drills so both
-    exercise the same path."""
+    exercise the same path. ``trace_id`` names the caller's blocking
+    tensor so the error points into the flight-recorder dump."""
     lost = getattr(resp, "lost_ranks", ())
     if lost:
         raise RanksLostError(
             lost, reason="declared lost by the coordinator's liveness "
-                         "ledger")
+                         "ledger",
+            trace_id=trace_id)
 
 
 def control_addresses():
@@ -904,10 +961,11 @@ class NegotiationWorker:
                 time.sleep(0.2)
 
     def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b"",
-              metrics=None):
+              metrics=None, flight=None):
         return self._client.request(
             CycleRequest(self._rank, entries, ack, shutdown,
-                         req_id=req_id, hits=hits, metrics=metrics))
+                         req_id=req_id, hits=hits, metrics=metrics,
+                         flight=flight))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

@@ -55,6 +55,7 @@ import zlib
 from ..common import hvd_logging as log
 from ..common.config import env_float, env_int, env_str
 from ..utils import metrics as hvd_metrics
+from ..utils import tracing as hvd_tracing
 
 FAULTS = ("drop_request", "delay_request", "dup_request",
           "drop_response", "delay_response", "truncate_response", "reset")
@@ -167,6 +168,13 @@ class ChaosInjector:
                           service=self._service_name,
                           message=msg_type_name, rule=rule.text,
                           count=rule.injected)
+                # flight-recorder breadcrumb: the postmortem lines these
+                # up against the negotiation history to call out a drill
+                # (or a real network fault pattern) as the proximate cause
+                hvd_tracing.get_tracer().record_cycle(
+                    kind="chaos_injection", fault=rule.fault,
+                    service=self._service_name, message=msg_type_name,
+                    count=rule.injected)
                 log.warning("CHAOS: injecting %s on %s/%s (rule %r, #%d)",
                             rule.fault, self._service_name, msg_type_name,
                             rule.text, rule.injected)

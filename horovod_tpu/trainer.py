@@ -25,6 +25,7 @@ from .common import compat
 from . import optim
 from .ops.compression import Compression
 from .utils import metrics as hvd_metrics
+from .utils import tracing as hvd_tracing
 
 
 def instrument_step(step_fn, tokens_per_step=None, name="train"):
@@ -54,12 +55,18 @@ def instrument_step(step_fn, tokens_per_step=None, name="train"):
         "Throughput of the most recent step (tokens_per_step / step "
         "seconds).", labels=("loop",))
 
+    tracer = hvd_tracing.get_tracer()
+
     @functools.wraps(step_fn)
     def wrapped(*args, **kwargs):
         t0 = time.perf_counter()
-        out = step_fn(*args, **kwargs)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
+        # step span: the root every per-tensor span of this step hangs
+        # under in the postmortem timeline (stage="step", one per call)
+        with tracer.span(hvd_tracing.STEP, tensor=name) as span:
+            out = step_fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            span.annotate(seconds=dt)
         step_s.labels(loop=name).observe(dt)
         steps.labels(loop=name).inc()
         if tokens_per_step and dt > 0:

@@ -445,6 +445,78 @@ def test_hvd007_catches_lambda_passed_to_jit(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD008 — span leak
+# ---------------------------------------------------------------------------
+
+def test_hvd008_triggers_on_discarded_span(tmp_path):
+    found = lint_source(tmp_path, """\
+        from horovod_tpu.utils import tracing as hvd_tracing
+
+        def enqueue(tracer, name):
+            tracer.span("negotiate", tensor=name)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD008"]
+
+
+def test_hvd008_triggers_on_discarded_annotate_chain(tmp_path):
+    # annotate() returns the span, so chaining doesn't close it
+    found = lint_source(tmp_path, """\
+        def enqueue(name):
+            from horovod_tpu.utils.tracing import get_tracer
+            get_tracer().span("enqueue", tensor=name).annotate(op="sum")
+        """)
+    assert [f.rule for f in live(found)] == ["HVD008"]
+
+
+def test_hvd008_triggers_on_assigned_never_closed(tmp_path):
+    found = lint_source(tmp_path, """\
+        def run(tracer):
+            s = tracer.span("execute")
+            do_work()
+        """)
+    hits = live(found, "HVD008")
+    assert len(hits) == 1 and "'s'" in hits[0].message
+
+
+def test_hvd008_clean_forms(tmp_path):
+    found = lint_source(tmp_path, """\
+        def lexical(tracer):
+            with tracer.span("fusion") as fspan:
+                fspan.annotate(n_buckets=3)
+
+        def explicit(tracer):
+            s = tracer.span("execute")
+            try:
+                do_work()
+                s.close(bytes=128)
+            except Exception as exc:
+                s.abort(exc)
+                raise
+
+        def stored(tracer, entry):
+            # ownership handed to the entry: closed elsewhere by design
+            entry.span = tracer.span("negotiate")
+
+        def escapes(tracer):
+            a = tracer.span("step")
+            register(a)           # passed on: callee owns the close
+            b = tracer.span("cycle")
+            return b              # returned: caller owns the close
+        """)
+    assert live(found) == []
+
+
+def test_hvd008_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        def fire_and_forget(tracer):
+            tracer.span("enqueue")  # hvdlint: disable=HVD008(leak drill)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD008"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -504,7 +576,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD00{i}" for i in range(1, 8)]
+    assert sorted(RULES) == [f"HVD00{i}" for i in range(1, 9)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

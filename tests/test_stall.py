@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from horovod_tpu.utils import metrics as hvd_metrics
+from horovod_tpu.utils import tracing as hvd_tracing
 
 
 @pytest.fixture
@@ -22,11 +23,13 @@ def hvd_stall(monkeypatch):
     monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.15")
     monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.8")
     hvd_metrics.reset(enabled=True)
+    hvd_tracing.reset(enabled=True)
     import horovod_tpu as hvd_mod
     hvd_mod.init()
     yield hvd_mod
     hvd_mod.shutdown()
     hvd_metrics.reset()
+    hvd_tracing.reset()
 
 
 def _coord():
@@ -50,6 +53,10 @@ class TestStall:
             assert reg.gauge("hvd_stalled_tensors").value == 1
             events = [e for e in reg.events() if e["event"] == "stall"]
             assert events and "slow" in events[-1]["tensors"], events
+            # the stall event names the blocking tensor's trace id —
+            # the pointer an operator follows into the flight dump
+            tid = hvd_tracing.get_tracer().trace_id_for("slow")
+            assert tid and tid in events[-1]["trace_ids"], events[-1]
             # warned, not killed: releasing the flush completes it, and
             # the next scan CLEARS the gauge — stall state is current
             coord._paused = False
@@ -106,8 +113,33 @@ class TestStall:
             (kill,) = [e for e in reg.events()
                        if e["event"] == "stall_kill"]
             assert "killed" in kill["tensors"]
+            tid = hvd_tracing.get_tracer().trace_id_for("killed")
+            assert tid and tid in kill["trace_ids"], kill
         finally:
             coord._paused = False
+
+    def test_stall_error_and_ranks_lost_carry_trace_ids(self, hvd_stall):
+        """The failure surfaces themselves carry the trace id: the
+        StalledError message from a background kill, and a
+        RanksLostError built with the blocking tensor's trace — so the
+        error text alone is enough to find the span in a flight dump."""
+        from horovod_tpu.common.exceptions import RanksLostError
+        coord = _coord()
+        coord._paused = True
+        try:
+            h = hvd_stall.allreduce_async(np.ones((8, 1)), name="traced")
+            tid = hvd_tracing.get_tracer().trace_id_for("traced")
+            assert tid  # minted at enqueue
+            time.sleep(0.9)
+            coord._check_stalled()
+            with pytest.raises(hvd_stall.StalledError,
+                               match=tid.replace(".", r"\.")):
+                hvd_stall.synchronize(h)
+        finally:
+            coord._paused = False
+        err = RanksLostError([2, 0], reason="drill", trace_id=tid)
+        assert err.trace_id == tid
+        assert f"[trace {tid}]" in str(err)
 
     def test_shutdown_fails_pending_handles(self, hvd_stall):
         """SHUT_DOWN_ERROR propagation to outstanding callbacks

@@ -1,0 +1,328 @@
+"""Fast unit tests for the tracing plane (utils/tracing.py) and the
+postmortem analyzer (tools/hvd_postmortem.py): the span model, the
+flight-recorder rings and dump format, and the cross-rank merge math —
+everything that must hold BEFORE the multi-rank chaos drill in
+tests/test_chaos_plane.py exercises the same machinery end to end.
+No coordinator, no processes: these run in the CI quick gate."""
+
+import json
+import os
+import signal
+import sys
+
+import pytest
+
+from horovod_tpu.utils import metrics as hvd_metrics
+from horovod_tpu.utils import tracing as hvd_tracing
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import hvd_postmortem  # noqa: E402
+
+
+@pytest.fixture
+def tracer():
+    """A live tracer at rank 3 over a live metrics registry, torn down
+    to the env-driven defaults afterwards."""
+    hvd_metrics.reset(enabled=True)
+    t = hvd_tracing.reset(enabled=True, rank=3)
+    yield t
+    hvd_tracing.reset()
+    hvd_metrics.reset()
+
+
+class TestSpanModel:
+    def test_trace_ids_mint_and_lookup(self, tracer):
+        a = tracer.new_trace_id("grad_0")
+        b = tracer.new_trace_id("grad_1")
+        assert a == "r3.1" and b == "r3.2"
+        assert tracer.trace_id_for("grad_0") == a
+        assert tracer.trace_id_for("never_seen") is None
+        # a fresh id for the same tensor supersedes (latest wins)
+        c = tracer.new_trace_id("grad_0")
+        assert tracer.trace_id_for("grad_0") == c
+
+    def test_span_reuses_tensor_trace_id(self, tracer):
+        tid = tracer.new_trace_id("g")
+        s = tracer.span(hvd_tracing.NEGOTIATE, tensor="g")
+        assert s.trace_id == tid
+        other = tracer.span(hvd_tracing.ENQUEUE, tensor="h")
+        assert other.trace_id != tid  # unseen tensor mints its own
+        s.close()
+        other.close()
+
+    def test_close_is_idempotent_and_moves_to_ring(self, tracer):
+        s = tracer.span(hvd_tracing.EXECUTE, tensor="t", op="allreduce")
+        assert s.open and s in tracer.open_spans()
+        s.close(bytes=128)
+        assert not s.open and s.status == "ok"
+        assert tracer.open_spans() == []
+        s.close(status="error")  # second close: no-op
+        assert s.status == "ok"
+        (rec,) = tracer.spans()
+        assert rec["stage"] == hvd_tracing.EXECUTE
+        assert rec["attrs"]["bytes"] == 128 and rec["attrs"]["op"] == \
+            "allreduce"
+        assert rec["end_us"] >= rec["start_us"]
+
+    def test_abort_records_error(self, tracer):
+        s = tracer.span(hvd_tracing.NEGOTIATE, tensor="t")
+        s.abort(ValueError("ranks [2] are lost"))
+        assert s.status == "error"
+        (rec,) = tracer.spans()
+        assert "are lost" in rec["attrs"]["error"]
+
+    def test_context_manager_aborts_on_exception(self, tracer):
+        with pytest.raises(RuntimeError):
+            with tracer.span(hvd_tracing.FUSION) as s:
+                raise RuntimeError("boom")
+        assert s.status == "error"
+        assert "RuntimeError: boom" in s.attrs["error"]
+        with tracer.span(hvd_tracing.CALLBACK) as s2:
+            s2.annotate(n=1)
+        assert s2.status == "ok"
+
+    def test_parent_links(self, tracer):
+        ex = tracer.span(hvd_tracing.EXECUTE, tensor="t")
+        cb = tracer.span(hvd_tracing.CALLBACK, tensor="t", parent=ex)
+        assert cb.parent_id == ex.span_id
+        cb.close()
+        ex.close()
+        by_stage = {r["stage"]: r for r in tracer.spans()}
+        assert by_stage["callback"]["parent_id"] == \
+            by_stage["execute"]["span_id"]
+
+
+class TestFlightRecorder:
+    def test_span_ring_bounds_and_drop_count(self):
+        t = hvd_tracing.Tracer(rank=0, span_ring=4, cycle_ring=2)
+        for i in range(6):
+            t.span(hvd_tracing.ENQUEUE, tensor=f"t{i}").close()
+        assert len(t.spans()) == 4
+        assert [r["tensor"] for r in t.spans()] == \
+            ["t2", "t3", "t4", "t5"]  # oldest evicted
+        assert t.flight_snapshot()["spans_dropped"] == 2
+        for i in range(3):
+            t.record_cycle(req_id=i)
+        assert [c["req_id"] for c in t.cycles()] == [1, 2]
+
+    def test_flight_snapshot_schema(self, tracer):
+        open_span = tracer.span(hvd_tracing.NEGOTIATE, tensor="stuck")
+        tracer.span(hvd_tracing.ENQUEUE, tensor="done").close()
+        tracer.record_cycle(req_id=7, ack=6)
+        snap = tracer.flight_snapshot("unit_test")
+        assert snap["version"] == hvd_tracing.FLIGHT_VERSION
+        assert snap["rank"] == 3 and snap["reason"] == "unit_test"
+        assert snap["epoch_us_at_ts0"] > 0 and snap["ts_us"] >= 0
+        assert [s["tensor"] for s in snap["open_spans"]] == ["stuck"]
+        assert [s["tensor"] for s in snap["spans"]] == ["done"]
+        assert snap["cycles"][0]["req_id"] == 7
+        assert isinstance(snap["events"], list)
+        json.dumps(snap)  # the whole thing must be JSON-serializable
+        open_span.close()
+
+    def test_dump_writes_file_and_counts(self, tracer, tmp_path):
+        tracer._dump_dir = str(tmp_path)
+        tracer.span(hvd_tracing.ENQUEUE, tensor="t").close()
+        path = tracer.dump("drill")
+        assert path == str(tmp_path / "flight-rank3.json")
+        with open(path) as f:
+            snap = json.load(f)
+        assert snap["rank"] == 3 and snap["reason"] == "drill"
+        reg = hvd_metrics.get_registry()
+        assert reg.counter(
+            "hvd_flight_dumps_total",
+            labels=("reason",)).labels(reason="drill").value == 1
+
+    def test_dump_never_raises(self, tracer, tmp_path):
+        blocker = tmp_path / "file"
+        blocker.write_text("")
+        # dirname is a regular file: makedirs/open must fail — quietly
+        assert tracer.dump("x", path=str(blocker / "sub" / "d.json")) \
+            is None
+
+    def test_slow_span_event_and_histogram(self):
+        hvd_metrics.reset(enabled=True)
+        try:
+            t = hvd_tracing.Tracer(rank=1, slow_ms=0.0)  # everything slow
+            t.span(hvd_tracing.EXECUTE, tensor="big",
+                   trace_id="r1.9").close()
+            reg = hvd_metrics.get_registry()
+            (ev,) = [e for e in reg.events() if e["event"] == "slow_span"]
+            assert ev["tensor"] == "big" and ev["trace_id"] == "r1.9"
+            assert ev["stage"] == hvd_tracing.EXECUTE
+            assert "hvd_span_seconds" in reg.snapshot()["metrics"]
+        finally:
+            hvd_metrics.reset()
+
+    def test_write_remote_dump(self, tracer, tmp_path):
+        tracer._dump_dir = str(tmp_path)
+        payload = {"rank": 5, "spans": [], "reason": "coordinator_request"}
+        path = hvd_tracing.write_remote_dump(payload)
+        assert path == str(tmp_path / "flight-rank5.json")
+        assert json.load(open(path))["rank"] == 5
+        assert hvd_tracing.write_remote_dump("not a dict") is None
+
+
+class TestLifecycleAndGates:
+    def test_null_tracer_absorbs_everything(self):
+        t = hvd_tracing.reset(enabled=False)
+        try:
+            assert not t.enabled
+            assert t.new_trace_id("x") is None
+            assert t.trace_id_for("x") is None
+            s = t.span(hvd_tracing.ENQUEUE, tensor="x")
+            assert s is hvd_tracing._NULL_SPAN
+            assert s.annotate(a=1).close().abort() is s
+            with pytest.raises(ValueError):
+                with t.span(hvd_tracing.STEP):  # must not swallow
+                    raise ValueError("boom")
+            assert t.spans() == [] and t.cycles() == []
+            assert t.dump("x") is None
+            assert t.flight_snapshot()["disabled"] is True
+        finally:
+            hvd_tracing.reset()
+
+    def test_env_gate_and_set_rank(self, monkeypatch):
+        monkeypatch.setenv("HVD_TRACE", "0")
+        hvd_tracing.reset()
+        assert not hvd_tracing.get_tracer().enabled
+        monkeypatch.setenv("HVD_TRACE", "1")
+        hvd_tracing.reset()
+        t = hvd_tracing.get_tracer()
+        assert t.enabled and t.rank is None
+        hvd_tracing.set_rank(4)
+        assert t.rank == 4
+        assert t.new_trace_id().startswith("r4.")
+        hvd_tracing.reset()
+
+    def test_sigterm_dump_chains_previous_handler(
+            self, tracer, tmp_path, monkeypatch):
+        tracer._dump_dir = str(tmp_path)
+        tracer.span(hvd_tracing.STEP).close()
+        hits = []
+        orig = signal.getsignal(signal.SIGTERM)
+        monkeypatch.setattr(hvd_tracing, "_sigterm_installed", False)
+        monkeypatch.setattr(hvd_tracing, "_sigterm_prev", None)
+        try:
+            signal.signal(signal.SIGTERM, lambda *a: hits.append(a))
+            assert hvd_tracing.install_signal_dump()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert hits, "previous handler must still run"
+            assert (tmp_path / "flight-rank3.json").exists()
+            snap = json.load(open(tmp_path / "flight-rank3.json"))
+            assert snap["reason"] == "sigterm"
+        finally:
+            signal.signal(signal.SIGTERM, orig)
+
+    def test_sigterm_install_respects_env_gate(self, monkeypatch):
+        monkeypatch.setenv("HVD_FLIGHT_SIGTERM", "0")
+        monkeypatch.setattr(hvd_tracing, "_sigterm_installed", False)
+        assert hvd_tracing.install_signal_dump() is False
+
+
+# -- postmortem merge math --------------------------------------------------
+
+def _dump(rank, anchor, spans=(), open_spans=(), cycles=(), events=(),
+          reason="test"):
+    return {"version": 1, "rank": rank, "reason": reason, "ts_us": 10_000,
+            "epoch_us_at_ts0": anchor, "spans": list(spans),
+            "open_spans": list(open_spans), "cycles": list(cycles),
+            "spans_dropped": 0, "events": list(events),
+            "_path": f"flight-rank{rank}.json"}
+
+
+def _neg_span(tensor, trace_id, start_us, end_us=None, cycle=None,
+              **attrs):
+    s = {"trace_id": trace_id, "span_id": 1, "stage": "negotiate",
+         "rank": None, "tensor": tensor, "start_us": start_us,
+         "end_us": end_us, "status": "ok" if end_us else "open"}
+    if cycle is not None:
+        attrs["cycle"] = cycle
+    if attrs:
+        s["attrs"] = attrs
+    return s
+
+
+class TestPostmortem:
+    def test_rebase_anchors_ranks_onto_one_clock(self):
+        # rank 1 started 1s after rank 0: same ts_us, 1s apart merged
+        d0 = _dump(0, 1_000_000,
+                   spans=[_neg_span("g", "r0.1", 100, 200, cycle=1)])
+        d1 = _dump(1, 2_000_000,
+                   spans=[_neg_span("g", "r1.1", 100, 200, cycle=1)])
+        base = hvd_postmortem.rebase([d0, d1])
+        assert base == 1_000_000
+        assert d0["spans"][0]["t0_us"] == 100
+        assert d1["spans"][0]["t0_us"] == 1_000_100
+        assert d1["spans"][0]["t1_us"] == 1_000_200
+
+    def test_rebase_prefers_event_epoch_stamp(self):
+        d = _dump(0, 1_000_000,
+                  events=[{"event": "stall", "epoch_us": 1_500_000},
+                          {"event": "stall", "ts_us": 300}])
+        hvd_postmortem.rebase([d])
+        assert d["events"][0]["t_us"] == 500_000
+        assert d["events"][1]["t_us"] == 300
+
+    def test_stitch_groups_by_cycle_and_tensor(self):
+        d0 = _dump(0, 0, spans=[_neg_span("g", "r0.1", 0, 10, cycle=4),
+                                _neg_span("h", "r0.2", 0, 10)])  # no cycle
+        d1 = _dump(1, 0, spans=[_neg_span("g", "r1.1", 5, 15, cycle=4)])
+        groups = hvd_postmortem.stitch([d0, d1])
+        assert set(groups) == {(4, "g")}
+        assert sorted(groups[(4, "g")]) == [0, 1]
+
+    def test_analyze_names_divergent_rank_and_tensor(self):
+        # ranks 0 and 1 wait on grad_7; rank 2 never enqueued it and the
+        # coordinator declared rank 2 lost — verdict must say both
+        waiting = _neg_span("grad_7", "r0.3", 50)
+        d0 = _dump(0, 0, open_spans=[waiting],
+                   events=[{"event": "ranks_lost", "ranks": [2]}])
+        d1 = _dump(1, 0, open_spans=[_neg_span("grad_7", "r1.3", 60)])
+        d2 = _dump(2, 0, cycles=[{"kind": "chaos_injection",
+                                  "fault": "drop_response", "ts_us": 1}])
+        hvd_postmortem.rebase([d0, d1, d2])
+        v = hvd_postmortem.analyze([d0, d1, d2])
+        assert v["divergent_rank"] == 2
+        assert v["tensor"] == "grad_7" and v["trace_id"] == "r0.3"
+        assert v["never_enqueued"] == {"grad_7": [2]}
+        assert v["waiting"] == {"grad_7": [0, 1]}
+        assert len(v["chaos_injections"]) == 1
+        assert any("never enqueued" in r for r in v["reasons"])
+
+    def test_main_json_and_trace(self, tmp_path, capsys):
+        for d in (_dump(0, 0,
+                        spans=[_neg_span("g", "r0.1", 0, 10, cycle=2)],
+                        open_spans=[_neg_span("stuck", "r0.2", 5)]),
+                  _dump(1, 0,
+                        spans=[_neg_span("g", "r1.1", 2, 12, cycle=2)])):
+            p = tmp_path / f"flight-rank{d['rank']}.json"
+            d.pop("_path")
+            p.write_text(json.dumps(d))
+        trace_out = tmp_path / "out.trace.json"
+        rc = hvd_postmortem.main(["--dir", str(tmp_path), "--json",
+                                  "--trace", str(trace_out)])
+        assert rc == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["stitched_collectives"] == 1
+        assert verdict["tensor"] == "stuck"
+        trace = json.loads(trace_out.read_text())
+        phases = {e["ph"] for e in trace["traceEvents"]}
+        assert {"X", "i", "s", "f", "M"} <= phases
+
+    def test_main_handles_no_and_bad_dumps(self, tmp_path):
+        assert hvd_postmortem.main(["--dir", str(tmp_path)]) == 2
+        (tmp_path / "flight-rank0.json").write_text("{trunc")
+        assert hvd_postmortem.main(["--dir", str(tmp_path)]) == 2
+
+    def test_load_dumps_tolerates_malformed(self, tmp_path):
+        good = tmp_path / "flight-rank1.json"
+        good.write_text(json.dumps(
+            {k: v for k, v in _dump(1, 0).items() if k != "_path"}))
+        bad = tmp_path / "flight-rank0.json"
+        bad.write_text("{not json")
+        dumps, badlist = hvd_postmortem.load_dumps(
+            [str(bad), str(good)])
+        assert [d["rank"] for d in dumps] == [1]
+        assert len(badlist) == 1 and str(bad) in badlist[0][0]

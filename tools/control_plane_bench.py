@@ -20,6 +20,7 @@ import time
 
 from horovod_tpu.common.config import HorovodConfig
 from horovod_tpu.ops import negotiation as neg
+from horovod_tpu.run import network
 
 
 class _Worker:
@@ -91,8 +92,9 @@ def run_case(nproc, ntensors, steps, cache_capacity):
     cfg = HorovodConfig(fusion_threshold=64 << 20,
                         stall_warning_time_seconds=0,
                         cache_capacity=cache_capacity)
-    port = 47000 + (cache_capacity > 0)
-    addrs = [("127.0.0.1", p) for p in range(port, port + 8)]
+    # per-run free ports (not a fixed base): concurrent CI shards and
+    # back-to-back cases must not collide on TIME_WAIT sockets
+    addrs = [("127.0.0.1", network.free_port())]
     workers = [None] * nproc
 
     def make(rank):
@@ -129,6 +131,9 @@ def run_case(nproc, ntensors, steps, cache_capacity):
         "steady_req_bytes_per_worker": round(steady),
         "cold_cycle_ms": round(lat[0], 2),
         "steady_cycle_ms": round(statistics.mean(lat[1:]), 2),
+        # min is robust to scheduler noise: the overhead gate in
+        # bench.py compares best-case latencies, not means
+        "best_cycle_ms": round(min(lat[1:]), 3),
     }
 
 

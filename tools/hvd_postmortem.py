@@ -1,0 +1,461 @@
+"""hvd_postmortem: cross-rank analysis of flight-recorder dumps.
+
+Merges the per-rank JSON dumps the tracing plane
+(horovod_tpu/utils/tracing.py) writes on failure — one
+``flight-rank<N>.json`` per rank under ``HVD_FLIGHT_DIR`` — into a
+single causal story:
+
+  * every rank's spans are re-timed onto one wall clock using the same
+    ``epoch_us_at_ts0`` anchor utils/merged_timeline.py merges on;
+  * negotiate spans are stitched across ranks on ``(cycle, tensor)`` —
+    the coordinator's response sequence number is globally consistent,
+    so one logical collective is one stitched group;
+  * the last N negotiation cycles are reconstructed per rank from the
+    cycle ring (request ids, acks, cache hits, chaos injections,
+    trace-time retraces);
+  * a divergence verdict names the rank and tensor the failure hinges
+    on: ranks blamed by ``ranks_lost`` events / RanksLostError spans,
+    tensors some ranks negotiated (or still wait on) that other ranks
+    never enqueued, with chaos injections called out as probable cause.
+
+Output is a human report on stdout (or ``--out``) plus, with
+``--trace``, a Chrome/Perfetto trace: one pid per rank, one lane per
+lifecycle stage, flow arrows binding each stitched collective across
+ranks. ``--json`` emits the analysis verdict as machine-readable JSON
+(the chaos drill in tests/test_chaos_plane.py asserts on it).
+
+Usage:
+    python tools/hvd_postmortem.py [--dir DIR | dump.json ...]
+        [--cycles N] [--trace out.trace.json] [--json] [--out report.txt]
+
+Reading the report: docs/troubleshooting.md ("Reading a postmortem"),
+span catalog: docs/tracing.md.
+"""
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+try:
+    from horovod_tpu.utils import tracing as hvd_tracing
+except ImportError:  # run straight from a checkout: tools/ is no package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from horovod_tpu.utils import tracing as hvd_tracing
+
+
+# -- loading ----------------------------------------------------------------
+
+def find_dumps(dump_dir=None):
+    """All ``flight-rank*.json`` files in ``dump_dir`` (default: the
+    tracing plane's HVD_FLIGHT_DIR)."""
+    if dump_dir is None:
+        dump_dir = hvd_tracing.flight_dir()
+    return sorted(glob.glob(os.path.join(dump_dir, "flight-rank*.json")))
+
+
+def load_dumps(paths):
+    """Parse dump files, tolerating (and reporting) malformed ones —
+    a crashing rank may have left a truncated file."""
+    dumps, bad = [], []
+    for path in paths:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            if not isinstance(d, dict) or "spans" not in d:
+                raise ValueError("not a flight dump")
+            d["_path"] = path
+            dumps.append(d)
+        except (OSError, ValueError) as exc:
+            bad.append((path, str(exc)))
+    dumps.sort(key=lambda d: _rank_of(d))
+    return dumps, bad
+
+
+def _rank_of(dump):
+    r = dump.get("rank")
+    return int(r) if r is not None else -1
+
+
+# -- clock merge (the merged_timeline.py anchor math) -----------------------
+
+def rebase(dumps):
+    """Re-time every span/cycle/event onto one epoch-anchored clock.
+
+    Each dump carries ``epoch_us_at_ts0`` — the wall-clock epoch at that
+    process's monotonic zero — so ``anchor + ts_us`` is comparable
+    across ranks (modulo host clock skew, same caveat merged_timeline
+    accepts). Times are rebased to the earliest anchor so traces start
+    near zero. Mutates the dumps in place, adding ``t0_us``/``t1_us``
+    (spans) and ``t_us`` (cycles, events); returns the base epoch (µs).
+    """
+    anchors = [d.get("epoch_us_at_ts0") for d in dumps
+               if d.get("epoch_us_at_ts0")]
+    base = min(anchors) if anchors else 0
+    for d in dumps:
+        anchor = d.get("epoch_us_at_ts0") or base
+        off = anchor - base
+        for s in d.get("spans", []) + d.get("open_spans", []):
+            s["t0_us"] = s.get("start_us", 0) + off
+            if s.get("end_us") is not None:
+                s["t1_us"] = s["end_us"] + off
+        for c in d.get("cycles", []):
+            c["t_us"] = c.get("ts_us", 0) + off
+        for e in d.get("events", []):
+            # metrics events carry their own epoch stamp already
+            if e.get("epoch_us"):
+                e["t_us"] = e["epoch_us"] - base
+            else:
+                e["t_us"] = e.get("ts_us", 0) + off
+    return base
+
+
+# -- cross-rank stitching ---------------------------------------------------
+
+def stitch(dumps):
+    """Group negotiate spans by the cross-rank key ``(cycle, tensor)``.
+
+    Returns {(cycle, tensor): {rank: span}} for spans that closed with a
+    coordinator-assigned cycle. Open negotiate spans have no cycle yet —
+    they are exactly the 'still waiting' set analyze() reads.
+    """
+    groups = collections.defaultdict(dict)
+    for d in dumps:
+        rank = _rank_of(d)
+        for s in d.get("spans", []):
+            if s.get("stage") != hvd_tracing.NEGOTIATE:
+                continue
+            cycle = (s.get("attrs") or {}).get("cycle")
+            if cycle is None or s.get("tensor") is None:
+                continue
+            groups[(cycle, s["tensor"])][rank] = s
+    return dict(groups)
+
+
+# -- analysis ---------------------------------------------------------------
+
+def analyze(dumps):
+    """The divergence verdict: which rank, which tensor, and why.
+
+    Evidence, strongest first:
+      1. ``ranks_lost`` events and RanksLostError-aborted spans name
+         ranks explicitly — the control plane's own verdict.
+      2. A tensor some ranks hold open negotiate spans for (or closed
+         at a cycle) while another rank's dump never mentions it — that
+         rank never enqueued the collective: classic divergence.
+      3. Chaos injections in the rings are surfaced as probable cause.
+    """
+    ranks = sorted(_rank_of(d) for d in dumps)
+    blame = collections.Counter()
+    reasons = []
+
+    # 1. explicit declarations
+    for d in dumps:
+        for e in d.get("events", []):
+            if e.get("event") == "ranks_lost":
+                for r in e.get("ranks", []):
+                    blame[int(r)] += 10
+                reasons.append(
+                    f"rank {_rank_of(d)}'s coordinator ledger declared "
+                    f"ranks {sorted(e.get('ranks', []))} lost")
+        for s in d.get("spans", []):
+            err = (s.get("attrs") or {}).get("error", "")
+            if "RanksLostError" in str(err) or "are lost" in str(err):
+                for tok in str(err).replace("[", " ").replace("]", " ") \
+                        .replace(",", " ").split():
+                    if tok.isdigit():
+                        blame[int(tok)] += 1
+                        break
+
+    # 2. enqueue asymmetry: tensors known to some ranks but not others
+    seen = collections.defaultdict(set)      # tensor -> ranks that saw it
+    waiting = collections.defaultdict(dict)  # tensor -> {rank: open span}
+    for d in dumps:
+        rank = _rank_of(d)
+        for s in d.get("spans", []) + d.get("open_spans", []):
+            if s.get("tensor"):
+                seen[s["tensor"]].add(rank)
+        for s in d.get("open_spans", []):
+            if (s.get("stage") == hvd_tracing.NEGOTIATE and
+                    s.get("tensor")):
+                waiting[s["tensor"]][rank] = s
+    missing = {}
+    for tensor, who in seen.items():
+        absent = [r for r in ranks if r not in who]
+        if absent and tensor in waiting:
+            missing[tensor] = absent
+            for r in absent:
+                blame[r] += 5
+            reasons.append(
+                f"tensor '{tensor}' is waiting on ranks "
+                f"{sorted(waiting[tensor])} but was never enqueued on "
+                f"ranks {absent}")
+
+    # 3. chaos as probable cause
+    chaos = []
+    for d in dumps:
+        for c in d.get("cycles", []):
+            if c.get("kind") == "chaos_injection":
+                chaos.append({"rank": _rank_of(d), **c})
+        for e in d.get("events", []):
+            if e.get("event") == "chaos_injection":
+                chaos.append({"rank": _rank_of(d), **e})
+
+    # the blocking tensor: longest-waiting open negotiate span, else the
+    # tensor the stall/lost events most recently named
+    tensor = None
+    trace_id = None
+    if waiting:
+        tensor = min(
+            waiting,
+            key=lambda t: min(s.get("t0_us", s.get("start_us", 0))
+                              for s in waiting[t].values()))
+        first = min(waiting[tensor].values(),
+                    key=lambda s: s.get("t0_us", s.get("start_us", 0)))
+        trace_id = first.get("trace_id")
+    else:
+        for d in dumps:
+            for e in reversed(d.get("events", [])):
+                if e.get("event") in ("stall", "stall_kill"):
+                    tensor = (e.get("tensor") or
+                              (e.get("tensors") or [None])[0])
+                    trace_id = e.get("trace_id")
+                    break
+            if tensor:
+                break
+
+    divergent = blame.most_common(1)[0][0] if blame else None
+    return {
+        "ranks": ranks,
+        "divergent_rank": divergent,
+        "tensor": tensor,
+        "trace_id": trace_id,
+        "blame": dict(blame),
+        "reasons": reasons,
+        "waiting": {t: sorted(w) for t, w in waiting.items()},
+        "never_enqueued": missing,
+        "chaos_injections": chaos,
+    }
+
+
+def last_cycles(dumps, n):
+    """Per rank, the last ``n`` negotiation-cycle records (newest
+    last) — the 'what was the control plane doing' reconstruction."""
+    out = {}
+    for d in dumps:
+        recs = [c for c in d.get("cycles", [])
+                if c.get("kind") != "chaos_injection"]
+        out[_rank_of(d)] = recs[-n:]
+    return out
+
+
+# -- rendering --------------------------------------------------------------
+
+def _fmt_us(us):
+    return f"{us / 1e6:9.3f}s"
+
+
+def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
+    lines = []
+    lines.append("=" * 72)
+    lines.append("HVD POSTMORTEM — merged flight-recorder analysis")
+    lines.append("=" * 72)
+    for d in dumps:
+        lines.append(
+            f"  rank {_rank_of(d):>3}: {len(d.get('spans', []))} spans, "
+            f"{len(d.get('open_spans', []))} open, "
+            f"{len(d.get('cycles', []))} cycle records "
+            f"(reason: {d.get('reason') or '?'}, {d['_path']})")
+    for path, why in bad:
+        lines.append(f"  UNREADABLE: {path} ({why})")
+    lines.append(f"  clock base: epoch {base_epoch} µs "
+                 f"(all times below are relative to it)")
+
+    lines.append("")
+    lines.append("-- verdict " + "-" * 61)
+    if verdict["divergent_rank"] is not None:
+        lines.append(f"  divergent rank : {verdict['divergent_rank']}")
+    else:
+        lines.append("  divergent rank : (none identified)")
+    if verdict["tensor"]:
+        tid = f" [trace {verdict['trace_id']}]" if verdict["trace_id"] \
+            else ""
+        lines.append(f"  blocking tensor: {verdict['tensor']}{tid}")
+    for r in verdict["reasons"]:
+        lines.append(f"  - {r}")
+    if verdict["chaos_injections"]:
+        lines.append(f"  probable cause : {len(verdict['chaos_injections'])}"
+                     f" chaos injection(s) in the rings:")
+        for c in verdict["chaos_injections"][:6]:
+            lines.append(
+                f"      rank {c.get('rank')}: {c.get('fault')} on "
+                f"{c.get('service', '?')}/{c.get('message', '?')}")
+
+    if verdict["waiting"]:
+        lines.append("")
+        lines.append("-- still waiting at dump time " + "-" * 42)
+        for tensor, who in sorted(verdict["waiting"].items()):
+            absent = verdict["never_enqueued"].get(tensor)
+            note = f"  (never enqueued on {absent})" if absent else ""
+            lines.append(f"  {tensor}: open on ranks {who}{note}")
+
+    lines.append("")
+    lines.append("-- last negotiation cycles per rank " + "-" * 36)
+    for rank in sorted(cycles_by_rank):
+        recs = cycles_by_rank[rank]
+        lines.append(f"  rank {rank}:")
+        if not recs:
+            lines.append("    (no cycle records)")
+        for c in recs:
+            fields = {k: v for k, v in c.items()
+                      if k not in ("ts_us", "t_us")}
+            lines.append(f"    [{_fmt_us(c.get('t_us', 0))}] {fields}")
+
+    ev = []
+    for d in dumps:
+        for e in d.get("events", []):
+            if e.get("event") in ("stall", "stall_kill", "ranks_lost",
+                                  "chaos_injection", "slow_span"):
+                ev.append((e.get("t_us", 0), _rank_of(d), e))
+    if ev:
+        lines.append("")
+        lines.append("-- escalation events (all ranks, merged) " + "-" * 31)
+        for t, rank, e in sorted(ev, key=lambda x: x[0])[-20:]:
+            detail = {k: v for k, v in e.items()
+                      if k not in ("event", "ts_us", "epoch_us", "t_us")}
+            lines.append(f"  [{_fmt_us(t)}] rank {rank} "
+                         f"{e.get('event')}: {detail}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# -- Chrome/Perfetto trace --------------------------------------------------
+
+def chrome_trace(dumps, stitched):
+    """One pid per rank, one named lane per lifecycle stage, complete
+    (X) events for spans, instant events for the escalation log, and
+    flow arrows (s/f) binding each stitched ``(cycle, tensor)`` group —
+    open chrome://tracing or ui.perfetto.dev on the output."""
+    events = []
+    lanes = {stage: i for i, stage in enumerate(hvd_tracing.STAGES)}
+    for d in dumps:
+        rank = _rank_of(d)
+        pid = rank if rank >= 0 else 999
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"hvd rank {rank}"}})
+        for stage, tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": stage}})
+        for s in d.get("spans", []):
+            if s.get("t1_us") is None:
+                continue
+            events.append({
+                "name": s.get("tensor") or s.get("stage", "span"),
+                "cat": s.get("stage", "span"), "ph": "X",
+                "ts": s["t0_us"], "dur": max(s["t1_us"] - s["t0_us"], 1),
+                "pid": pid, "tid": lanes.get(s.get("stage"), 0),
+                "args": {"trace_id": s.get("trace_id"),
+                         "status": s.get("status"),
+                         **(s.get("attrs") or {})}})
+        for s in d.get("open_spans", []):
+            events.append({
+                "name": f"OPEN {s.get('tensor') or s.get('stage')}",
+                "cat": "open", "ph": "i", "s": "p",
+                "ts": s.get("t0_us", 0), "pid": pid,
+                "tid": lanes.get(s.get("stage"), 0),
+                "args": {"trace_id": s.get("trace_id")}})
+        for e in d.get("events", []):
+            kind = e.get("event")
+            if kind in ("stall", "stall_kill", "ranks_lost",
+                        "chaos_injection"):
+                events.append({
+                    "name": kind, "cat": "event", "ph": "i", "s": "g",
+                    "ts": e.get("t_us", 0), "pid": pid, "tid": 0,
+                    "args": {k: v for k, v in e.items()
+                             if k not in ("ts_us", "epoch_us", "t_us")}})
+    # flow arrows: one id per stitched collective, start at the earliest
+    # rank's negotiate close, finish at each later rank's
+    for fid, ((cycle, tensor), by_rank) in enumerate(
+            sorted(stitched.items())):
+        if len(by_rank) < 2:
+            continue
+        order = sorted(by_rank.items(),
+                       key=lambda kv: kv[1].get("t1_us") or 0)
+        first_rank, first = order[0]
+        events.append({"name": f"cycle{cycle}:{tensor}", "cat": "stitch",
+                       "ph": "s", "id": fid,
+                       "ts": first.get("t1_us") or first.get("t0_us", 0),
+                       "pid": first_rank,
+                       "tid": lanes[hvd_tracing.NEGOTIATE]})
+        for rank, s in order[1:]:
+            events.append({"name": f"cycle{cycle}:{tensor}",
+                           "cat": "stitch", "ph": "f", "bp": "e",
+                           "id": fid,
+                           "ts": s.get("t1_us") or s.get("t0_us", 0),
+                           "pid": rank,
+                           "tid": lanes[hvd_tracing.NEGOTIATE]})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="*",
+                    help="flight dump files (default: all flight-rank*."
+                         "json under --dir)")
+    ap.add_argument("--dir", default=None,
+                    help="directory to scan for dumps (default: "
+                         "HVD_FLIGHT_DIR)")
+    ap.add_argument("--cycles", type=int, default=8,
+                    help="negotiation cycles to reconstruct per rank")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="also write a Chrome/Perfetto trace here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the analysis verdict as JSON instead of "
+                         "the human report")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    paths = args.dumps or find_dumps(args.dir)
+    if not paths:
+        print("hvd_postmortem: no flight dumps found (looked in "
+              f"{args.dir or hvd_tracing.flight_dir()})", file=sys.stderr)
+        return 2
+    dumps, bad = load_dumps(paths)
+    if not dumps:
+        for path, why in bad:
+            print(f"hvd_postmortem: unreadable dump {path}: {why}",
+                  file=sys.stderr)
+        return 2
+    base = rebase(dumps)
+    stitched = stitch(dumps)
+    verdict = analyze(dumps)
+    verdict["stitched_collectives"] = len(stitched)
+
+    if args.trace:
+        trace = chrome_trace(dumps, stitched)
+        with open(args.trace, "w") as f:
+            json.dump(trace, f)
+        print(f"hvd_postmortem: wrote {len(trace['traceEvents'])} trace "
+              f"events to {args.trace}", file=sys.stderr)
+
+    if args.json:
+        text = json.dumps(verdict, indent=2, sort_keys=True)
+    else:
+        text = render_report(dumps, bad, verdict,
+                             last_cycles(dumps, args.cycles), base)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ except ImportError:  # run straight from a checkout: tools/ is no package
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from horovod_tpu.utils import metrics as hvd_metrics
+from horovod_tpu.utils import tracing as hvd_tracing
 
 BOLD = "\x1b[1m"
 DIM = "\x1b[2m"
@@ -51,7 +52,13 @@ def fetch(base_url, timeout=3.0):
         with urllib.request.urlopen(base + "/metrics.json",
                                     timeout=timeout) as r:
             view = json.loads(r.read().decode())
-        return view.get("aggregate", view), view.get("ranks", {})
+        # a disabled/null registry may serve `null` or a bare list —
+        # render an empty frame instead of crashing the poll loop
+        if not isinstance(view, dict):
+            return {}, {}
+        agg = view.get("aggregate", view)
+        return (agg if isinstance(agg, dict) else {}), \
+            (view.get("ranks") or {})
     except (urllib.error.URLError, ValueError, OSError):
         pass
     with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
@@ -172,7 +179,11 @@ def _fmt_rate(r, unit=""):
 
 
 def render(snap, ranks_view, prev=None, dt=0.0, color=True):
-    """One frame of the dashboard as a string."""
+    """One frame of the dashboard as a string. Tolerates an empty or
+    null-registry snapshot (HVD_METRICS=0 serves one): every section
+    renders its placeholder rather than crashing ``--once``."""
+    snap = snap if isinstance(snap, dict) else {}
+    ranks_view = ranks_view if isinstance(ranks_view, dict) else {}
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     lines = []
@@ -181,6 +192,9 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
     head = "hvd_top — ranks: " + (
         ",".join(str(r) for r in ranks) if ranks else "local")
     lines.append(c(BOLD, head))
+    if snap.get("disabled") or not snap.get("metrics"):
+        lines.append(c(DIM, "  (metrics registry empty or disabled — "
+                            "set HVD_METRICS=1 on the job)"))
 
     # health strip first: this is what an operator glances at
     stalled = _total(snap, "hvd_stalled_ranks")
@@ -265,6 +279,39 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         lines.append(f"    steps {scount:>8,}   mean {_fmt_s(ssum / scount):>8}"
                      f"   p50 {_fmt_s(sp50):>8}   tokens/s {tps:,.0f}")
 
+    # tracing plane: per-stage span latency + the slow-span tail
+    span_entry = snap.get("metrics", {}).get("hvd_span_seconds")
+    slow = [e for e in snap.get("events", [])
+            if e.get("event") == "slow_span"][-4:]
+    if span_entry or slow:
+        lines.append(c(BOLD, "  tracing"))
+    if span_entry and span_entry.get("values"):
+        bounds = span_entry.get("buckets", [])
+        by_stage = {v.get("labels", {}).get("stage", "?"): v
+                    for v in span_entry["values"]}
+        order = [s for s in hvd_tracing.STAGES if s in by_stage] + \
+            sorted(s for s in by_stage if s not in hvd_tracing.STAGES)
+        for stage in order:
+            v = by_stage[stage]
+            counts = v.get("counts", [])
+            sp50 = hvd_metrics.histogram_quantile(bounds, counts, 0.5)
+            sp99 = hvd_metrics.histogram_quantile(bounds, counts, 0.99)
+            lines.append(f"    {stage:<13} spans {v.get('count', 0):>9,}"
+                         f"   p50 {_fmt_s(sp50):>8}   "
+                         f"p99 {_fmt_s(sp99):>8}")
+    elif span_entry is not None or slow:
+        lines.append(c(DIM, "    (no spans recorded yet)"))
+    dumps = _by_label(snap, "hvd_flight_dumps_total", "reason")
+    if dumps:
+        lines.append(c(RED, "    flight dumps  " + "  ".join(
+            f"{k}={int(v)}" for k, v in sorted(dumps.items()))))
+    for ev in slow:
+        lines.append(c(YELLOW,
+                       f"    slow span     {ev.get('stage', '?'):<10} "
+                       f"{ev.get('tensor') or '-':<20} "
+                       f"{ev.get('dur_ms', 0):>9.1f}ms  "
+                       f"trace {ev.get('trace_id') or '-'}"))
+
     # event tail
     events = snap.get("events", [])[-8:]
     if events:
@@ -317,8 +364,17 @@ def canned_snapshot():
         sh.labels(loop="train").observe(0.085)
     reg.gauge("hvd_tokens_per_second",
               "g", labels=("loop",)).labels(loop="train").set(385000)
+    sp = reg.histogram("hvd_span_seconds", "h", labels=("stage",))
+    for stage, v in (("enqueue", 0.0001), ("negotiate", 0.004),
+                     ("execute", 0.002), ("callback", 0.0002)):
+        for _ in range(50):
+            sp.labels(stage=stage).observe(v)
+    reg.counter("hvd_flight_dumps_total", "c",
+                labels=("reason",)).labels(reason="stall").inc()
+    reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",
+              trace_id="r1.42", dur_ms=412.5, status="ok")
     reg.event("stall", tensor="grad/dense_7", missing_ranks=[3],
-              waited_s=61.2)
+              waited_s=61.2, trace_id="r1.42")
     reg.event("chaos_injection", fault="drop_response",
               service="hvd.negotiation", message="CycleResponse",
               rule="demo", count=5)

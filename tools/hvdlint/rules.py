@@ -738,6 +738,119 @@ def check_jit_purity(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD008 — span leak
+# ---------------------------------------------------------------------------
+
+_SPAN_CLOSERS = {"close", "abort"}
+
+
+def _is_span_call(node):
+    """A tracing-plane span open: ``<tracer>.span(...)`` where the
+    receiver is something tracer-shaped — a name/attribute containing
+    'tracer' (``self._tracer``, ``tracer``) or a ``get_tracer()`` call
+    chain (``hvd_tracing.get_tracer().span(...)``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "span"):
+        return False
+    val = fn.value
+    if isinstance(val, ast.Call):
+        chain = _attr_chain(val.func)
+        return bool(chain) and chain[-1] == "get_tracer"
+    chain = _attr_chain(val)
+    return bool(chain) and "tracer" in chain[-1].lower()
+
+
+def _unwrap_span_chain(node):
+    """``tracer.span(...).annotate(...)`` still yields the span."""
+    while (isinstance(node, ast.Call) and
+           isinstance(node.func, ast.Attribute) and
+           node.func.attr == "annotate"):
+        node = node.func.value
+    return node
+
+
+def _walk_scope(body):
+    """Every node under ``body`` WITHOUT descending into nested function
+    definitions — span lifetime is judged within one lexical scope."""
+    out = []
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue  # inner scope: judged on its own pass
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _name_escapes(scope_nodes, name):
+    """True if ``name`` reaches a close/abort call OR escapes the scope
+    (returned, yielded, passed to a call, stored on an object, used as a
+    context manager) — any of which hands off close responsibility."""
+    for node in scope_nodes:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and
+                    fn.attr in _SPAN_CLOSERS and
+                    isinstance(fn.value, ast.Name) and
+                    fn.value.id == name):
+                return True
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+        elif isinstance(node, (ast.Return, ast.Yield)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+        elif isinstance(node, ast.withitem):
+            ce = node.context_expr
+            if isinstance(ce, ast.Name) and ce.id == name:
+                return True
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Name) and node.value.id == name:
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                    return True
+    return False
+
+
+def check_span_leak(ctx, shared):
+    scopes = [ctx.tree.body] + \
+        [f.body for f in _iter_function_defs(ctx.tree)]
+    for body in scopes:
+        scope_nodes = _walk_scope(body)
+        for node in scope_nodes:
+            if isinstance(node, ast.Expr) and \
+                    _is_span_call(_unwrap_span_chain(node.value)):
+                yield Finding(
+                    "HVD008", ctx.relpath, node.lineno, node.col_offset,
+                    "span opened and immediately discarded: nothing can "
+                    "ever close() or abort() it, so it stays in the "
+                    "tracer's open-span table forever and the flight "
+                    "recorder reports it as eternally in flight. Use the "
+                    "context-manager form (`with tracer.span(...)`) or "
+                    "keep the reference and close it on every path.")
+            elif isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    _is_span_call(_unwrap_span_chain(node.value)):
+                name = node.targets[0].id
+                if not _name_escapes(scope_nodes, name):
+                    yield Finding(
+                        "HVD008", ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"span assigned to '{name}' but no close()/"
+                        "abort() (or escape: return/yield/arg-pass/"
+                        "attribute store/with) is reachable in this "
+                        "scope — the span leaks open and pollutes the "
+                        "flight recorder's open-span table. Close it on "
+                        "every path or use the context-manager form.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -883,5 +996,38 @@ Fix: hoist the read out and pass it as an argument (static or traced),
 or use jax.debug.print / jax.experimental.io_callback for intentional
 runtime effects.""",
             check_jit_purity),
+        Rule(
+            "HVD008", "span-leak",
+            "tracing span opened without a close/abort path",
+            """HVD008 — span leak
+
+The tracing plane (utils/tracing.py) keeps every open span in the
+tracer's open-span table until close() or abort() moves it into the
+flight-recorder ring. A span that is opened and then discarded — or
+bound to a local that no path ever closes — sits in that table forever:
+the flight dump reports it as eternally in flight, the postmortem's
+'still waiting' analysis names it as a blocked tensor that never
+existed, and the per-stage hvd_span_seconds histogram silently loses
+the stage. That is an observability plane lying about the data plane —
+worse than no data.
+
+Flags two shapes at tracer call sites (receivers named *tracer* or
+get_tracer() chains): (1) a ``.span(...)`` call used as a bare
+expression statement (annotate-chained or not) — nothing holds the
+span, nothing can close it; (2) a span assigned to a local name with
+no reachable close()/abort() in the same scope AND no escape that
+hands off responsibility (returned, yielded, passed as an argument,
+stored on an object attribute, or used as a context manager).
+
+The negotiate spans in ops/eager.py live across methods by design:
+they are stored on the TensorTableEntry (an attribute store — an
+escape) and closed in _apply_cycle_response or aborted on the failure
+paths; that pattern stays clean under this rule.
+
+Fix: prefer the context-manager form (``with tracer.span(...)``) for
+lexical extents; for spans that outlive the function, store them on the
+owning object and audit every terminal path (success, error, shutdown)
+for a close()/abort().""",
+            check_span_leak),
     ]
 }
