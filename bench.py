@@ -329,6 +329,107 @@ def _bench_flight_overhead(workers=4, tensors=100, steps=6,
     return out
 
 
+def _bench_numerics_overhead(tensors=64, elems=1024, steps=6, rounds=3,
+                             target_step_ms=200.0, budget_pct=2.0):
+    """Numerics-plane overhead contract (docs/numerics.md): gradient
+    health + divergence digests default-on must cost <=2% of a
+    training-shaped step, end to end.
+
+    The denominator is the honest part. A bare flush of tiny host
+    arrays is ~10 ms of pure control overhead against which ANY
+    per-byte pass looks enormous, and a multi-process CPU drill cannot
+    run the data plane at all (cross-process collectives are
+    unimplemented on the CPU backend). So the step here is shaped like
+    training: a jitted matmul chain — calibrated to ~target_step_ms so
+    the percentage means the same thing on any machine — produces the
+    gradient arrays on device, then the real eager flush allreduces
+    them, with stats riding the flush exactly as in production (one
+    compiled pass per bucket, one host transfer, gauges/EMA/policy).
+    Interleaved off/on windows with best-of-min cancel machine drift;
+    extra rounds run only when a round lands outside the budget (same
+    protocol as _bench_flight_overhead). Raises AssertionError past
+    the budget — a CI gate, not a report."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.utils import numerics as hvd_numerics
+
+    B, D = 256, 1024
+    assert tensors * elems <= B * D  # the chain output IS the grads
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((D, D)) / 32.0, jnp.float32)
+
+    def make_work(repeats):
+        @jax.jit
+        def work(x):
+            y = jax.lax.fori_loop(0, repeats,
+                                  lambda _, y: jnp.tanh(y @ w), x)
+            return y.reshape(-1)[:tensors * elems].reshape(tensors,
+                                                           elems)
+        return work
+
+    # pre-warm every pow2 stats-kernel variant the racy flush splits
+    # can request for this shape: compiles belong to process warmup
+    # (amortized over a training run), not to a timed window
+    zero = jnp.zeros((elems,), jnp.float32)
+    p = 1
+    while p <= tensors:
+        hvd_numerics._group_stats_fn(p, (elems,))(*([zero] * p))
+        p *= 2
+
+    work = make_work(4)
+    work(x0).block_until_ready()
+    t0 = time.perf_counter()
+    work(x0).block_until_ready()
+    t1 = (time.perf_counter() - t0) * 1e3
+    repeats = max(4, int(4 * target_step_ms / max(t1, 1e-3)))
+    if repeats != 4:
+        work = make_work(repeats)
+        work(x0).block_until_ready()
+
+    def step():
+        grads = work(x0)
+        handles = [hvd.allreduce_async(grads[i], average=True,
+                                       name=f"bench_grad_{i}")
+                   for i in range(tensors)]
+        for h in handles:
+            hvd.synchronize(h)
+
+    def window(enabled):
+        hvd_numerics.reset(enabled=enabled)
+        step()  # toggle warmup: compiles the stats kernels, untimed
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step()
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    best = {True: float("inf"), False: float("inf")}
+    try:
+        for _ in range(rounds):
+            for enabled in (False, True):
+                best[enabled] = min(best[enabled], window(enabled))
+            if best[True] <= best[False] * (1.0 + budget_pct / 100.0):
+                break
+    finally:
+        hvd_numerics.reset()  # back to the env-driven default
+    off, on = best[False], best[True]
+    overhead_pct = (on - off) / off * 100.0
+    out = {"tensors": tensors, "elems": elems,
+           "calibrated_chain_repeats": repeats,
+           "numerics_off_best_step_ms": round(off, 3),
+           "numerics_on_best_step_ms": round(on, 3),
+           "overhead_pct": round(overhead_pct, 2),
+           "budget_pct": budget_pct}
+    assert overhead_pct <= budget_pct, (
+        f"numerics plane overhead {overhead_pct:.2f}% exceeds the "
+        f"{budget_pct}% budget: {out}")
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -490,6 +591,13 @@ def main():
     flight = None
     if os.environ.get("HVD_BENCH_FLIGHT", "") != "0":
         flight = _bench_flight_overhead()
+    # Numerics-plane overhead gate: stats default-on vs off around a
+    # training-shaped step (calibrated jitted compute + real eager
+    # flush). The <=2% budget is ENFORCED (AssertionError);
+    # HVD_BENCH_NUMERICS=0 skips it.
+    numerics = None
+    if os.environ.get("HVD_BENCH_NUMERICS", "") != "0":
+        numerics = _bench_numerics_overhead()
 
     image_size = 224 if on_tpu else 64
     # Largest per-chip batch that compiles+runs wins MXU utilization; fall
@@ -644,6 +752,7 @@ def main():
         "flash_ablation": flash_ablation,
         "profile": profile,
         "flight_recorder": flight,
+        "numerics": numerics,
         "metrics": metrics_snap,
     }))
     return 0

@@ -45,6 +45,14 @@ echo "--- tracing (fast fail: span model, flight recorder, postmortem merge)"
 # broken flight recorder fails CI before the expensive drills run.
 python -m pytest tests/test_tracing.py -q -m "not slow"
 
+echo "--- numerics (fast fail: stats math, anomaly policy, divergence sentinel)"
+# The numerics plane is default-on in every training run; a broken stats
+# kernel or sentinel rule corrupts the one signal that catches silent
+# divergence. The suite is process-local (the TCP piggyback test binds
+# one loopback socket) and runs in seconds; the multi-process poisoned-
+# rank drill stays with the other drills in test_chaos_plane.py.
+python -m pytest tests/test_numerics.py -q -m "not slow"
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

@@ -215,6 +215,23 @@ ENV_REGISTRY = (
      "Seconds between rank-0 metrics aggregation pulls."),
     ("HOROVOD_METRICS_PORT", True, "0", "common/config.py",
      "Rank-0 HTTP port for /metrics and /metrics.json (0 disables)."),
+    ("HOROVOD_NUMERICS", True, "1", "utils/numerics.py",
+     "Set 0 to replace the numerics plane (gradient health stats + "
+     "divergence sentinel) with no-ops."),
+    ("HOROVOD_NUMERICS_DIGEST_CYCLES", True, "32", "utils/numerics.py",
+     "How many recent cycles the coordinator retains cross-rank "
+     "digests for."),
+    ("HOROVOD_NUMERICS_EMA_BETA", True, "0.9", "utils/numerics.py",
+     "Decay of the per-tensor gradient-norm EMA the spike policy "
+     "compares against."),
+    ("HOROVOD_NUMERICS_EMA_K", True, "8.0", "utils/numerics.py",
+     "Flag a norm_spike anomaly when a gradient norm exceeds k times "
+     "its EMA."),
+    ("HOROVOD_NUMERICS_TOLERANCE", True, "1e-4", "utils/numerics.py",
+     "Relative cross-rank disagreement tolerance for post-allreduce "
+     "digest records."),
+    ("HOROVOD_NUMERICS_WARMUP", True, "5", "utils/numerics.py",
+     "Per-tensor observations before the norm-spike policy arms."),
     ("HOROVOD_RANK_LOST_TIMEOUT_SECONDS", True, "0.0",
      "common/config.py",
      "Coordinator declares a silent rank lost after this long "
@@ -307,6 +324,8 @@ ENV_REGISTRY = (
      "Force the flash-attention ablation legs on (1) or off (0)."),
     ("HVD_BENCH_FLIGHT", False, None, "bench.py",
      "Set 0 to skip the flight-recorder overhead gate in bench.py."),
+    ("HVD_BENCH_NUMERICS", False, None, "bench.py",
+     "Set 0 to skip the numerics-overhead gate in bench.py."),
     ("HVD_TEST_WORKERS", False, "auto", "ci/run_tests.sh",
      "pytest-xdist worker count for the CI suite."),
 )

@@ -217,8 +217,17 @@ def allreduce_async(tensor, average=True, name=None,
     ``kind`` overrides the eager core's stacked/replicated shape heuristic
     for callers that know their tensor's semantics."""
     coord = _coordinator()
+    resolved = _auto_name("allreduce", name)
     compressed, ctx = compression.compress(tensor)
-    handle = coord.enqueue(_auto_name("allreduce", name), eager_mod.ALLREDUCE,
+    if ctx is not None:
+        # lossy wire cast happened: record the norm delta (host-side
+        # only — this is the eager path; the traced paths in
+        # ops/collective_ops.py stay jit-pure)
+        from .utils import numerics as numerics_mod
+        numerics_mod.get_monitor().observe_compression(
+            resolved, tensor, compressed,
+            getattr(compression, "name", "unknown"))
+    handle = coord.enqueue(resolved, eager_mod.ALLREDUCE,
                            compressed, average=average, kind=kind)
     if ctx is not None:
         coord.handles.get(handle).postscale = ctx  # dtype to restore

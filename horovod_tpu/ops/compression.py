@@ -18,6 +18,11 @@ class Compressor:
     """Interface to compress and decompress a tensor
     (reference compression.py:20-33)."""
 
+    # metric label for the numerics plane's pre/post-compression norm
+    # delta (hvd_compression_norm_delta in utils/numerics.py) — the
+    # error-feedback dashboard quantized collectives will A/B against
+    name = "none"
+
     @staticmethod
     def compress(tensor):
         """Returns (compressed_tensor, context_for_decompression)."""
@@ -30,6 +35,8 @@ class Compressor:
 
 class NoneCompressor(Compressor):
     """No-op (reference compression.py:36-47)."""
+
+    name = "none"
 
     @staticmethod
     def compress(tensor):
@@ -60,6 +67,7 @@ class _CastCompressor(Compressor):
 class FP16Compressor(_CastCompressor):
     """Cast float tensors to fp16 on the wire
     (reference compression.py:50-65)."""
+    name = "fp16"
     wire_dtype = jnp.float16
 
 
@@ -67,6 +75,7 @@ class BF16Compressor(_CastCompressor):
     """Cast float tensors to bfloat16 on the wire. TPU-native: bf16 is
     supported end-to-end by the MXU and ICI, unlike fp16 which the reference
     needed a software MPI sum for (horovod/common/half.cc:42-75)."""
+    name = "bf16"
     wire_dtype = jnp.bfloat16
 
 

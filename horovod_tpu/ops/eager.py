@@ -58,6 +58,7 @@ from ..common.exceptions import (DuplicateNameError, MismatchError,
                                  RanksLostError, ShutdownError,
                                  StalledError)
 from ..utils import metrics as hvd_metrics
+from ..utils import numerics as hvd_numerics
 from ..utils import timeline as timeline_mod
 from ..utils import tracing as hvd_tracing
 
@@ -330,6 +331,15 @@ class EagerCoordinator:
         # flight snapshot to its next CycleRequest in reply
         self._flight_send_pending = False
         self._flight_sent = False
+        # Numerics plane (utils/numerics.py): gradient-health stats as a
+        # side-product of allreduce execution, folded into a per-cycle
+        # digest that rides the next CycleRequest so the coordinator's
+        # divergence sentinel can compare replicas. The monitor is read
+        # through get_monitor() at each use so numerics.reset(enabled=)
+        # toggles a live engine (the bench's interleaved off/on arms).
+        self._numerics_pending = None  # digest awaiting piggyback
+        self._numerics_cycle = None    # seq being executed (None: local)
+        self._numerics_staged = None   # fused-bucket stats matrix
         self._m_neg_cycles = reg.counter(
             "hvd_negotiation_cycles_total",
             "Negotiation cycle RPCs completed by this worker.")
@@ -598,6 +608,8 @@ class EagerCoordinator:
         return groups
 
     def _execute(self, batch, plan):
+        mon = hvd_numerics.get_monitor()
+        observed = []
         for kind, idxs, average in plan:
             entries = [batch[i] for i in idxs]
             t0 = time.perf_counter()
@@ -618,6 +630,12 @@ class EagerCoordinator:
                 self._m_coll_bytes.labels(op=op_class).inc(nbytes)
                 self._m_coll_s.labels(op=op_class).observe(
                     time.perf_counter() - t0)
+                if op_class == ALLREDUCE and mon.enabled:
+                    # reduced side None on purpose: a single-process
+                    # allreduce returns the contribution itself, so one
+                    # stats half serves both digest sides
+                    observed.extend(
+                        (e.name, e.tensor, None) for e in entries)
                 ex_span.close(bytes=nbytes)
             # hvdlint: disable=HVD006(status carries the fault to every waiter)
             except Exception as exc:
@@ -633,6 +651,17 @@ class EagerCoordinator:
                         for e in entries:
                             self._tensor_table.pop(e.name, None)
                             e.event.set()
+        # gradient health ONCE per flush (not per plan group: an
+        # unfusable batch plans into singleton groups, and per-group
+        # observation would pay the host-boundary cost |batch| times).
+        # Runs after every waiter above is released — jax arrays are
+        # immutable, so observing off the critical path is safe. No
+        # cycle key on the local path, so no cross-rank digest to fold.
+        if observed:
+            try:
+                mon.observe(observed)
+            except Exception as exc:
+                log.error("numerics observe failed: %s", exc)
 
     # -- negotiated multi-process cycle (RunLoopOnce's coordinator
     # protocol, operations.cc:1246-1551, over the TCP control plane) --
@@ -718,15 +747,23 @@ class EagerCoordinator:
         if self._flight_send_pending:
             self._flight_send_pending = False
             flight = self._tracer.flight_snapshot("coordinator_request")
+        # numerics digest piggyback: every bucket executed since the last
+        # cycle rides this request for the coordinator's sentinel
+        digest, self._numerics_pending = self._numerics_pending, None
         t0 = time.perf_counter()
         try:
             resp = self._negotiator.cycle(metas, self._applied_seq,
                                           req_id=self._cycle_req_id,
                                           hits=neg.encode_hits(hit_ids),
-                                          metrics=push, flight=flight)
+                                          metrics=push, flight=flight,
+                                          digest=digest)
         # hvdlint: disable=HVD006(retried next cycle; counted in hvd_negotiation_failures and escalated by liveness fail-fast)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = (metas, hit_ids)
+            if digest is not None:
+                # don't lose the digest to a transient transport failure;
+                # the retry cycle carries it instead
+                self._numerics_pending = digest
             self._m_neg_failures.inc()
             now = time.monotonic()
             self._cycle_failures += 1
@@ -827,6 +864,29 @@ class EagerCoordinator:
             nbytes = sum(_entry_nbytes(e) for e in entries)
             self._m_coll_bytes.labels(op=op).inc(nbytes)
             self._m_coll_s.labels(op=op).observe(time.perf_counter() - t0)
+            # gradient-health side pass (utils/numerics.py): one stacked
+            # host transfer over the just-executed bucket; records fold
+            # into the digest the next CycleRequest piggybacks so the
+            # coordinator's sentinel can compare replicas
+            mon = hvd_numerics.get_monitor()
+            if op == ALLREDUCE and mon.enabled:
+                cyc = self._numerics_cycle
+                staged, self._numerics_staged = self._numerics_staged, None
+                if staged is not None:
+                    recs = mon.ingest(staged[0], staged[1], cycle=cyc)
+                else:
+                    recs = mon.observe(
+                        [(e.name, e.tensor, e.result) for e in entries],
+                        cycle=cyc)
+                if recs and cyc is not None:
+                    self._numerics_pending = hvd_numerics.fold_digest(
+                        self._numerics_pending, cyc, recs,
+                        rank=jax.process_index())
+                lead_rec = recs.get(lead.name)
+                if lead_rec is not None:
+                    ex_span.annotate(
+                        grad_l2=lead_rec[hvd_numerics.R_RED_L2],
+                        nonfinite=lead_rec[hvd_numerics.R_RED_NONFINITE])
             ex_span.close(bytes=nbytes)
         # hvdlint: disable=HVD006(status carries the fault to every waiter)
         except Exception as exc:  # noqa: BLE001 — status carries it
@@ -933,6 +993,9 @@ class EagerCoordinator:
                         self._neg_cache_ids.pop(old[0], None)
                     self._neg_cache[e.name] = (cid, e.signature())
                     self._neg_cache_ids[cid] = e.name
+            # digest key for the bucket about to execute: seq is globally
+            # consistent, so the sentinel lines it up across ranks
+            self._numerics_cycle = seq
             if r.kind == r.ERROR:
                 exc = MismatchError(r.error)
                 for e in entries:
@@ -957,6 +1020,7 @@ class EagerCoordinator:
                     entries, lambda es: self._exec_single(es[0], r.op,
                                                           "replicated"))
             self._applied_seq = seq
+        self._numerics_cycle = None
         for cid in getattr(resp, "unknown_ids", ()):
             # the coordinator no longer holds this id (evicted, or a peer
             # invalidated it with a changed signature): drop the mapping
@@ -1027,6 +1091,16 @@ class EagerCoordinator:
         with jax.profiler.TraceAnnotation(
                 f"hvd.fused_allreduce.x{len(entries)}"):
             summed = self._proc_engine.allreduce(fused, average=average)
+        if hvd_numerics.get_monitor().enabled:
+            # fused side-product: per-slice health stats in one segment
+            # pass over the buffers the collective already materialized;
+            # _finish_entries picks the staged matrix up (still on
+            # device — the host transfer happens in ingest)
+            from . import fusion as fusion_mod
+            sizes = [int(f.shape[0]) for f in flats]
+            self._numerics_staged = (names, jnp.concatenate(
+                [fusion_mod.bucket_stats(summed, sizes),
+                 fusion_mod.bucket_stats(fused, sizes)], axis=1))
         if tl:
             for n in names:
                 tl.end_activity(n)

@@ -150,6 +150,18 @@ def _nbytes(leaf):
         leaf, "shape") else leaf.nbytes
 
 
+def bucket_stats(flat, sizes):
+    """Per-slice gradient-health stats of an already-materialized fused
+    buffer: ONE segment-reduction pass over the whole bucket (the
+    numerics plane's "fused side-product" contract — the buffer was
+    paid for by the collective; the stats ride along). ``sizes`` are
+    the static per-slice element counts in buffer order; returns an
+    [n, 5] device matrix in the utils/numerics.py S_* layout. The math
+    lives in the sanctioned numerics module (hvdlint HVD009)."""
+    from ..utils import numerics as numerics_mod
+    return numerics_mod.segment_stats(flat, sizes)
+
+
 def fuse(leaves, bucket):
     """Concatenate the bucket's leaves into one flat buffer (device-side,
     fuses into the collective under jit)."""

@@ -39,6 +39,7 @@ from ..common import hvd_logging as log
 from ..common.exceptions import RanksLostError
 from ..run import network, secret
 from ..utils import metrics as hvd_metrics
+from ..utils import numerics as hvd_numerics
 from ..utils import tracing as hvd_tracing
 
 # ops (mirrors eager.py's constants; import cycle keeps them local)
@@ -313,11 +314,17 @@ def decode_response(payload):
 
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
-                 hits=b"", metrics=None, flight=None):
+                 hits=b"", metrics=None, flight=None, digest=None):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
         self.shutdown = shutdown
+        # numerics digest piggyback (utils/numerics.py): per-cycle
+        # gradient-health records ({"v", "rank", "cycles": {seq: {name:
+        # record}}}) for the coordinator's cross-rank divergence
+        # sentinel (_numerics_scan). Requests are plain-pickled, so
+        # adding the field is wire-safe — same pattern as `metrics`.
+        self.digest = digest
         # flight-recorder piggyback (utils/tracing.py): when the previous
         # CycleResponse carried dump_requested, the worker attaches its
         # flight snapshot here (once) so the coordinator can persist every
@@ -473,6 +480,17 @@ class CoordinatorService(network.BasicService):
         self._tracer = hvd_tracing.get_tracer()
         self._dump_requested = False
         self.flight_dumps = {}
+        # divergence sentinel (utils/numerics.py): per-cycle digests by
+        # rank, compared as they arrive; a disagreement past tolerance
+        # escalates once per (cycle, tensor, kind) through the standard
+        # path (event -> warning -> dump solicitation -> postmortem)
+        self._digests = {}            # cycle -> rank -> {name: record}
+        # (cycle, tensor, kind) -> blamed rank. A dict, not a set: the
+        # first record to expose an anomaly may lack blame evidence
+        # (e.g. reduced-side nonfinites before the poisoned rank's local
+        # digest arrives), and the flag upgrades once a culprit is known
+        self._numerics_flagged = {}
+        self._numerics_first_bad = {}   # tensor -> first bad cycle
         reg = self._metrics = hvd_metrics.get_registry()
         self._m_cycles = reg.counter(
             "hvd_coordinator_cycles_total",
@@ -502,6 +520,14 @@ class CoordinatorService(network.BasicService):
         self._m_lost_ranks = reg.gauge(
             "hvd_lost_ranks",
             "Ranks declared LOST by the liveness ledger (terminal).")
+        self._m_numerics_anomalies = reg.counter(
+            "hvd_coordinator_numerics_anomalies_total",
+            "Anomalies the coordinator's divergence sentinel flagged "
+            "from piggybacked digests, by kind.", labels=("kind",))
+        self._m_divergent_rank = reg.gauge(
+            "hvd_numerics_divergent_rank",
+            "Rank the divergence sentinel blames (-1 = none).")
+        self._m_divergent_rank.set(-1)
         super().__init__(SERVICE_NAME, key)
 
     # bind to one of the agreed candidate ports instead of an ephemeral
@@ -533,6 +559,8 @@ class CoordinatorService(network.BasicService):
                         req.flight, rank=req.rank)
                     if path is not None:
                         self.flight_dumps[req.rank] = path
+                if getattr(req, "digest", None) is not None:
+                    self._numerics_scan(req.rank, req.digest)
                 self._last_seen[req.rank] = time.monotonic()
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
@@ -868,6 +896,97 @@ class CoordinatorService(network.BasicService):
                       f"{reason}.{suffix}"))
         self._order = []
 
+    def _numerics_scan(self, rank, digest):
+        """The cross-rank divergence sentinel. Called from _handle under
+        self._lock with one rank's piggybacked digest.
+
+        Post-allreduce state is replicated, so two ranks' records for
+        the same (cycle, tensor) disagreeing past tolerance is silent
+        corruption — the failure mode no other plane can see. Blame
+        falls on the rank whose LOCAL pre-reduce contribution is the
+        cross-rank outlier or carries nonfinites (the reduced copies
+        are redundant; the outlier's own input is the evidence).
+        Escalation follows the standard path — numerics_anomaly event →
+        trace-id-tagged warning → flight-dump solicitation — and the
+        postmortem ranks it above enqueue asymmetry."""
+        if not isinstance(digest, dict) or \
+                digest.get("v") != hvd_numerics.DIGEST_VERSION:
+            return
+        tol = hvd_numerics.tolerance()
+        for cycle in sorted(digest.get("cycles", ())):
+            records = digest["cycles"][cycle]
+            by_rank = self._digests.setdefault(int(cycle), {})
+            by_rank[rank] = dict(records)
+            for name in sorted(records):
+                rec = records[name]
+                nf_loc = int(rec[hvd_numerics.R_LOC_NONFINITE])
+                nf_red = int(rec[hvd_numerics.R_RED_NONFINITE])
+                if nf_loc or nf_red:
+                    blamed = rank if nf_loc else None
+                    if blamed is None:
+                        # reduced-side poison with clean local stats:
+                        # look for a peer whose local digest carries it
+                        for peer in sorted(by_rank):
+                            prec = by_rank[peer].get(name)
+                            if prec is not None and int(
+                                    prec[hvd_numerics.R_LOC_NONFINITE]):
+                                blamed = peer
+                                break
+                    self._numerics_flag(
+                        hvd_numerics.ANOMALY_NONFINITE, cycle, name,
+                        blamed, {"nonfinite_local": nf_loc,
+                                 "nonfinite_reduced": nf_red})
+                for peer in sorted(by_rank):
+                    if peer == rank:
+                        continue
+                    other = by_rank[peer].get(name)
+                    if other is None or not hvd_numerics.records_disagree(
+                            rec, other, tol):
+                        continue
+                    holders = {r: by_rank[r][name]
+                               for r in sorted(by_rank)
+                               if name in by_rank[r]}
+                    self._numerics_flag(
+                        hvd_numerics.ANOMALY_DIVERGENCE, cycle, name,
+                        hvd_numerics.blame_rank(holders),
+                        {"ranks": sorted(holders)})
+        # bound the digest store to the recent window
+        window = hvd_numerics.digest_window()
+        while len(self._digests) > window:
+            self._digests.pop(min(self._digests))
+
+    def _numerics_flag(self, kind, cycle, tensor, blamed, detail):
+        key = (int(cycle), tensor, kind)
+        prior = self._numerics_flagged.get(key, _UNFLAGGED)
+        if prior is not _UNFLAGGED and (prior is not None or
+                                        blamed is None):
+            return  # already flagged with blame at least as good
+        self._numerics_flagged[key] = blamed
+        first = min(self._numerics_first_bad.get(tensor, int(cycle)),
+                    int(cycle))
+        self._numerics_first_bad[tensor] = first
+        self._m_numerics_anomalies.labels(kind=kind).inc()
+        if blamed is not None:
+            self._m_divergent_rank.set(blamed)
+        trace_id = self._tracer.trace_id_for(tensor)
+        self._metrics.event(
+            "numerics_anomaly", anomaly=kind, tensor=tensor,
+            cycle=int(cycle), divergent_rank=blamed,
+            first_bad_cycle=first, trace_id=trace_id, **detail)
+        log.warning(
+            "numerics sentinel: %s on tensor '%s' at cycle %s "
+            "(divergent rank %s, first bad cycle %s, trace %s): %s",
+            kind, tensor, cycle, blamed, first, trace_id, detail)
+        if not self._dump_requested:
+            # escalate exactly like a stall: dump our own flight ring
+            # and solicit every rank's on their next cycle, so the
+            # postmortem can reconstruct the divergence
+            self._dump_requested = True
+            self._tracer.dump("numerics_anomaly")
+
+
+_UNFLAGGED = object()
+
 
 def raise_if_ranks_lost(resp, trace_id=None):
     """The worker half of the liveness protocol: fail fast when the
@@ -961,11 +1080,11 @@ class NegotiationWorker:
                 time.sleep(0.2)
 
     def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b"",
-              metrics=None, flight=None):
+              metrics=None, flight=None, digest=None):
         return self._client.request(
             CycleRequest(self._rank, entries, ack, shutdown,
                          req_id=req_id, hits=hits, metrics=metrics,
-                         flight=flight))
+                         flight=flight, digest=digest))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

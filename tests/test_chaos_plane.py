@@ -545,6 +545,140 @@ class TestDrillPostmortem:
         assert "grad_drill" in report
 
 
+@pytest.mark.chaos
+class TestDrillNumericsDivergence:
+    def test_poisoned_rank_yields_postmortem_verdict(self, tmp_path):
+        """Drill (d), the numerics plane end to end: 3 real processes
+        drive the negotiated control plane over TCP while each rank's
+        REAL NumericsMonitor digests its own gradient stream. Rank 0's
+        gradients are NaN-poisoned from cycle 2 on; the coordinator's
+        divergence sentinel must name rank 0, the tensor, and the first
+        bad cycle, solicit flight dumps from every rank — and
+        hvd_postmortem over the resulting dumps must reach the same
+        verdict. (The data plane never runs: multiprocess XLA
+        collectives do not exist on the CPU backend — the digests are
+        the product of the same observe path the eager flush feeds.)"""
+
+        port = network.free_port()
+
+        def fn():
+            import os
+            import time
+            import numpy as np
+            from horovod_tpu.common.config import HorovodConfig
+            from horovod_tpu.ops import negotiation as neg
+            from horovod_tpu.utils import metrics as hvd_metrics
+            from horovod_tpu.utils import numerics as hvd_numerics
+            from horovod_tpu.utils import tracing as hvd_tracing
+
+            rank = int(os.environ["HVD_PROCESS_ID"])
+            nproc = 3
+            addresses = [("127.0.0.1",
+                          int(os.environ["HVD_CHAOS_DRILL_PORTS"]))]
+            hvd_metrics.get_registry().rank = rank
+            hvd_tracing.reset(enabled=True, rank=rank)
+            mon = hvd_numerics.reset(enabled=True)
+            cfg = HorovodConfig(fusion_threshold=0,
+                                stall_warning_time_seconds=0)
+            worker = neg.NegotiationWorker(rank, nproc, cfg, addresses,
+                                           neg.control_key(),
+                                           start_timeout_s=60.0)
+            healthy_red = np.full((16,), 3.0, np.float32)
+            solicited = False
+            req_id = 0
+            try:
+                for cyc in range(5):
+                    loc = np.full((16,), 1.0 + rank, np.float32)
+                    red = healthy_red
+                    if rank == 0 and cyc >= 2:
+                        loc = loc.copy()
+                        loc[::4] = np.nan  # the injected perturbation
+                        # a poisoned replica reduces its own corrupt
+                        # copy; the healthy peers' post-state disagrees
+                        red = loc
+                    recs = mon.observe([("grad_poison", loc, red)],
+                                       cycle=cyc)
+                    digest = hvd_numerics.fold_digest(None, cyc, recs,
+                                                      rank=rank)
+                    req_id += 1
+                    resp = worker.cycle([], -1, req_id=req_id,
+                                        digest=digest)
+                    solicited = solicited or resp.dump_requested
+                # keep heartbeating until the coordinator's escalation
+                # solicits a flight dump (it races the loop above)
+                deadline = time.monotonic() + 30.0
+                while not solicited:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"rank {rank}: dump never solicited")
+                    req_id += 1
+                    solicited = worker.cycle(
+                        [], -1, req_id=req_id).dump_requested
+                    time.sleep(0.02)
+                # attach this rank's flight snapshot for the coordinator
+                # to persist (eager's loop does this automatically; the
+                # drill drives the protocol by hand)
+                req_id += 1
+                worker.cycle([], -1, req_id=req_id,
+                             flight=hvd_tracing.get_tracer()
+                             .flight_snapshot("solicited"))
+                flagged = first_bad = None
+                if rank == 0:
+                    svc = worker.service
+                    deadline = time.monotonic() + 30.0
+                    while len(svc.flight_dumps) < nproc:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"dumps missing: "
+                                f"{sorted(svc.flight_dumps)}")
+                        time.sleep(0.02)
+                    flagged = dict(svc._numerics_flagged)
+                    first_bad = dict(svc._numerics_first_bad)
+                return rank, flagged, first_bad
+            finally:
+                worker.close(linger_s=1.0)
+
+        env = dict(_ENV)
+        env["HVD_FLIGHT_DIR"] = str(tmp_path)
+        env["HVD_CHAOS_DRILL_PORTS"] = str(port)
+        results = run(fn, num_proc=3, env=env, start_timeout_s=180.0)
+
+        by_rank = {r: (flagged, first_bad)
+                   for r, flagged, first_bad in results}
+        assert sorted(by_rank) == [0, 1, 2]
+        flagged, first_bad = by_rank[0]
+        # the live sentinel named the rank, the tensor, the first cycle
+        assert flagged.get((2, "grad_poison", "nonfinite")) == 0, flagged
+        assert any(kind == "divergence" and blamed == 0
+                   for (_, _, kind), blamed in flagged.items()), flagged
+        assert first_bad == {"grad_poison": 2}
+
+        dumps = sorted(p.name for p in tmp_path.glob("flight-rank*.json"))
+        assert dumps == [f"flight-rank{r}.json" for r in range(3)], dumps
+
+        # ...and the offline postmortem reaches the same verdict from
+        # nothing but the dumps
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_postmortem
+        paths = hvd_postmortem.find_dumps(str(tmp_path))
+        loaded, bad = hvd_postmortem.load_dumps(paths)
+        assert not bad and len(loaded) == 3
+        hvd_postmortem.rebase(loaded)
+        verdict = hvd_postmortem.analyze(loaded)
+        assert verdict["divergent_rank"] == 0, verdict
+        assert verdict["tensor"] == "grad_poison", verdict
+        assert verdict["first_bad_cycle"] == 2, verdict
+        assert verdict["numerics_anomalies"], verdict
+        assert any("numerics" in r for r in verdict["reasons"]), verdict
+        report = hvd_postmortem.render_report(
+            loaded, [], verdict, hvd_postmortem.last_cycles(loaded, 8), 0)
+        assert "divergent rank : 0" in report
+        assert "first bad cycle: 2" in report
+        assert "grad_poison" in report
+
+
 class _ExitedProc:
     """A job process that has already exited with a scripted code."""
 

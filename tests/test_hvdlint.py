@@ -517,6 +517,71 @@ def test_hvd008_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD009 — ad-hoc numerics probe
+# ---------------------------------------------------------------------------
+
+def test_hvd009_triggers_on_adhoc_isnan(tmp_path):
+    found = lint_source(tmp_path, """\
+        import jax.numpy as jnp
+
+        def flush(grad):
+            if jnp.isnan(grad).any():
+                raise ValueError("nan gradient")
+            return grad
+        """)
+    assert [f.rule for f in live(found)] == ["HVD009"]
+    assert "isnan" in live(found)[0].message
+
+
+def test_hvd009_triggers_on_bare_imported_name(tmp_path):
+    found = lint_source(tmp_path, """\
+        from numpy import isfinite
+
+        def guard(x):
+            return isfinite(x).all()
+        """)
+    assert [f.rule for f in live(found)] == ["HVD009"]
+
+
+def test_hvd009_sanctioned_numerics_module_is_clean(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "utils"
+    mod.mkdir(parents=True)
+    f = mod / "numerics.py"
+    f.write_text(textwrap.dedent("""\
+        import jax.numpy as jnp
+
+        def tensor_stats(x):
+            return jnp.isfinite(x)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd009_routed_stats_call_is_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        from horovod_tpu.utils import numerics
+
+        def flush(flat, sizes):
+            return numerics.segment_stats(flat, sizes)
+        """)
+    assert live(found) == []
+
+
+def test_hvd009_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        import math
+
+        def host_guard(x):
+            return math.isnan(x)  # hvdlint: disable=HVD009(host scalar)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD009"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -576,7 +641,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD00{i}" for i in range(1, 9)]
+    assert sorted(RULES) == [f"HVD00{i}" for i in range(1, 10)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

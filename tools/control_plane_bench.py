@@ -29,7 +29,8 @@ class _Worker:
     via the seq-ordered response log — the same protocol steps as
     ops/eager.py _negotiated_flush_locked."""
 
-    def __init__(self, rank, nproc, config, addresses, key):
+    def __init__(self, rank, nproc, config, addresses, key,
+                 digest_fn=None):
         self.rank = rank
         self.neg = neg.NegotiationWorker(rank, nproc, config, addresses,
                                          key)
@@ -39,6 +40,11 @@ class _Worker:
         self.pending = set()
         self.req_bytes = []  # per-cycle request payload bytes
         self.cycles = 0
+        # optional numerics piggyback: digest_fn(rank, step) -> digest
+        # attached to the step's first cycle, mirroring eager's
+        # _negotiated_flush_locked (one digest per flush, not per cycle)
+        self.digest_fn = digest_fn
+        self.steps_done = 0
 
     def step(self, metas_by_name):
         """Announce every tensor (full meta or hit bit), then cycle until
@@ -54,10 +60,14 @@ class _Worker:
             else:
                 metas.append(meta)
         self.req_id += 1
+        digest = (self.digest_fn(self.rank, self.steps_done)
+                  if self.digest_fn is not None else None)
+        self.steps_done += 1
         wire = self.neg._client._wire
         before = wire.bytes_out
         resp = self.neg.cycle(metas, self.applied, req_id=self.req_id,
-                              hits=neg.encode_hits(hit_ids))
+                              hits=neg.encode_hits(hit_ids),
+                              digest=digest)
         self.req_bytes.append(wire.bytes_out - before)
         self.cycles = 1
         self._apply(resp, metas_by_name)
@@ -87,7 +97,7 @@ class _Worker:
             self.applied = seq
 
 
-def run_case(nproc, ntensors, steps, cache_capacity):
+def run_case(nproc, ntensors, steps, cache_capacity, digest_fn=None):
     key = b"b" * 32
     cfg = HorovodConfig(fusion_threshold=64 << 20,
                         stall_warning_time_seconds=0,
@@ -98,7 +108,8 @@ def run_case(nproc, ntensors, steps, cache_capacity):
     workers = [None] * nproc
 
     def make(rank):
-        workers[rank] = _Worker(rank, nproc, cfg, addrs, key)
+        workers[rank] = _Worker(rank, nproc, cfg, addrs, key,
+                                digest_fn=digest_fn)
 
     t0 = threading.Thread(target=make, args=(0,))
     t0.start()

@@ -15,8 +15,12 @@ single causal story:
     trace-time retraces);
   * a divergence verdict names the rank and tensor the failure hinges
     on: ranks blamed by ``ranks_lost`` events / RanksLostError spans,
-    tensors some ranks negotiated (or still wait on) that other ranks
-    never enqueued, with chaos injections called out as probable cause.
+    ``numerics_anomaly`` events from the numerics plane (nonfinite
+    bursts and cross-rank digest divergence — ranked above enqueue
+    asymmetry, below an explicit declaration; they also carry the
+    first bad cycle), tensors some ranks negotiated (or still wait on)
+    that other ranks never enqueued, with chaos injections called out
+    as probable cause.
 
 Output is a human report on stdout (or ``--out``) plus, with
 ``--trace``, a Chrome/Perfetto trace: one pid per rank, one lane per
@@ -143,10 +147,14 @@ def analyze(dumps):
     Evidence, strongest first:
       1. ``ranks_lost`` events and RanksLostError-aborted spans name
          ranks explicitly — the control plane's own verdict.
-      2. A tensor some ranks hold open negotiate spans for (or closed
+      2. ``numerics_anomaly`` events (utils/numerics.py): nonfinite or
+         cross-rank divergence evidence — the state is provably
+         corrupt, which outranks a merely missing enqueue, and the
+         event names the tensor and first bad cycle directly.
+      3. A tensor some ranks hold open negotiate spans for (or closed
          at a cycle) while another rank's dump never mentions it — that
          rank never enqueued the collective: classic divergence.
-      3. Chaos injections in the rings are surfaced as probable cause.
+      4. Chaos injections in the rings are surfaced as probable cause.
     """
     ranks = sorted(_rank_of(d) for d in dumps)
     blame = collections.Counter()
@@ -170,7 +178,32 @@ def analyze(dumps):
                         blame[int(tok)] += 1
                         break
 
-    # 2. enqueue asymmetry: tensors known to some ranks but not others
+    # 2. numerics anomalies: corrupt state outranks missing state
+    # (above asymmetry's +5, below an explicit declaration's +10).
+    # Coordinator sentinel events carry divergent_rank; worker-side
+    # health events carry the observing rank.
+    numerics = []
+    first_bad = None
+    for d in dumps:
+        for e in d.get("events", []):
+            if e.get("event") != "numerics_anomaly":
+                continue
+            numerics.append({"dump_rank": _rank_of(d), **e})
+            blamed = e.get("divergent_rank")
+            if blamed is None:
+                blamed = e.get("rank")
+            if blamed is not None:
+                blame[int(blamed)] += 7
+            bad = e.get("first_bad_cycle", e.get("cycle"))
+            if bad is not None:
+                first_bad = bad if first_bad is None else min(first_bad,
+                                                              bad)
+            reasons.append(
+                f"numerics: {e.get('anomaly')} anomaly on tensor "
+                f"'{e.get('tensor')}' at cycle {e.get('cycle')} "
+                f"(blamed rank {blamed})")
+
+    # 3. enqueue asymmetry: tensors known to some ranks but not others
     seen = collections.defaultdict(set)      # tensor -> ranks that saw it
     waiting = collections.defaultdict(dict)  # tensor -> {rank: open span}
     for d in dumps:
@@ -194,7 +227,7 @@ def analyze(dumps):
                 f"{sorted(waiting[tensor])} but was never enqueued on "
                 f"ranks {absent}")
 
-    # 3. chaos as probable cause
+    # 4. chaos as probable cause
     chaos = []
     for d in dumps:
         for c in d.get("cycles", []):
@@ -204,11 +237,20 @@ def analyze(dumps):
             if e.get("event") == "chaos_injection":
                 chaos.append({"rank": _rank_of(d), **e})
 
-    # the blocking tensor: longest-waiting open negotiate span, else the
+    # the blocking tensor: a numerics anomaly names it directly (the
+    # corrupt collective beats whatever happens to be waiting at dump
+    # time), else the longest-waiting open negotiate span, else the
     # tensor the stall/lost events most recently named
     tensor = None
     trace_id = None
-    if waiting:
+    if numerics:
+        first_ev = min(
+            numerics,
+            key=lambda e: (e.get("first_bad_cycle", e.get("cycle", 0))
+                           or 0))
+        tensor = first_ev.get("tensor")
+        trace_id = first_ev.get("trace_id")
+    elif waiting:
         tensor = min(
             waiting,
             key=lambda t: min(s.get("t0_us", s.get("start_us", 0))
@@ -238,6 +280,8 @@ def analyze(dumps):
         "waiting": {t: sorted(w) for t, w in waiting.items()},
         "never_enqueued": missing,
         "chaos_injections": chaos,
+        "numerics_anomalies": numerics,
+        "first_bad_cycle": first_bad,
     }
 
 
@@ -284,6 +328,8 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
         tid = f" [trace {verdict['trace_id']}]" if verdict["trace_id"] \
             else ""
         lines.append(f"  blocking tensor: {verdict['tensor']}{tid}")
+    if verdict.get("first_bad_cycle") is not None:
+        lines.append(f"  first bad cycle: {verdict['first_bad_cycle']}")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -293,6 +339,18 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
             lines.append(
                 f"      rank {c.get('rank')}: {c.get('fault')} on "
                 f"{c.get('service', '?')}/{c.get('message', '?')}")
+
+    if verdict.get("numerics_anomalies"):
+        lines.append("")
+        lines.append("-- numerics anomalies " + "-" * 50)
+        for e in verdict["numerics_anomalies"][:10]:
+            blamed = e.get("divergent_rank")
+            if blamed is None:
+                blamed = e.get("rank")
+            lines.append(
+                f"  {e.get('anomaly')}: tensor '{e.get('tensor')}' "
+                f"cycle {e.get('cycle')} blamed rank {blamed} "
+                f"(trace {e.get('trace_id')})")
 
     if verdict["waiting"]:
         lines.append("")
@@ -318,7 +376,8 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
     for d in dumps:
         for e in d.get("events", []):
             if e.get("event") in ("stall", "stall_kill", "ranks_lost",
-                                  "chaos_injection", "slow_span"):
+                                  "chaos_injection", "slow_span",
+                                  "numerics_anomaly"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
@@ -370,7 +429,7 @@ def chrome_trace(dumps, stitched):
         for e in d.get("events", []):
             kind = e.get("event")
             if kind in ("stall", "stall_kill", "ranks_lost",
-                        "chaos_injection"):
+                        "chaos_injection", "numerics_anomaly"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

@@ -5,8 +5,10 @@ the JSON snapshot at ``/metrics.json`` or the Prometheus text at
 ``/metrics``) and renders the control-plane vitals an operator watches
 during a run: negotiation cycle rate and latency percentiles, cache hit
 rate, collective bytes/s by op class, fusion fill, transport
-retries/chaos injections, stall and lost-rank state, and the tail of the
-structured event log. Rates are deltas between consecutive polls.
+retries/chaos injections, stall and lost-rank state, gradient numerics
+health (norms, EMA drift, nonfinite counts, divergence-sentinel
+verdicts — docs/numerics.md), and the tail of the structured event
+log. Rates are deltas between consecutive polls.
 
 Usage:
     python tools/hvd_top.py [http://host:port] [--interval 2]
@@ -269,6 +271,43 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         faults = "  ".join(f"{k}={int(v)}" for k, v in sorted(chaos.items()))
         lines.append(c(YELLOW, f"    chaos         {faults}"))
 
+    # numerics plane: gradient health + divergence sentinel
+    observed = _total(snap, "hvd_numerics_tensors_observed_total")
+    nonfinite = _by_label(snap, "hvd_nonfinite_total", "where")
+    anomalies = _by_label(snap, "hvd_numerics_anomalies_total", "kind")
+    for k, v in _by_label(snap, "hvd_coordinator_numerics_anomalies_total",
+                          "kind").items():
+        anomalies[k] = anomalies.get(k, 0.0) + v
+    drift = _by_label(snap, "hvd_grad_norm_drift", "tensor")
+    divergent = None
+    for v in _values(snap, "hvd_numerics_divergent_rank"):
+        if v.get("value", -1) >= 0:
+            divergent = int(v["value"])
+    if observed or nonfinite or anomalies or drift:
+        lines.append(c(BOLD, "  numerics"))
+        nf_total = sum(nonfinite.values())
+        summary = (f"    tensors       observed {int(observed):>8,}   "
+                   f"nonfinite {int(nf_total):>6,}")
+        lines.append(c(RED, summary) if nf_total else summary)
+        if anomalies:
+            kinds = "  ".join(f"{k}={int(v)}"
+                              for k, v in sorted(anomalies.items()))
+            lines.append(c(RED, f"    anomalies     {kinds}"))
+        if divergent is not None:
+            lines.append(c(RED, f"    DIVERGENT RANK: {divergent} "
+                                f"(run hvd_postmortem for the verdict)"))
+        # the tensors drifting hardest off their own EMA baseline
+        for tensor, d in sorted(drift.items(), key=lambda kv: -kv[1])[:4]:
+            norms = _by_label(snap, "hvd_grad_norm", "tensor")
+            line = (f"    {tensor[:24]:<24} norm "
+                    f"{norms.get(tensor, 0.0):>10.4g}   "
+                    f"drift x{d:.2f}")
+            lines.append(c(YELLOW, line) if d > 2.0 else line)
+        comp = _by_label(snap, "hvd_compression_norm_delta", "compressor")
+        if comp:
+            lines.append("    compression   " + "  ".join(
+                f"{k}Δ={v:.2e}" for k, v in sorted(comp.items())))
+
     # step path
     sh = _hist(snap, "hvd_step_seconds")
     if sh and sh[3]:
@@ -318,7 +357,8 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         lines.append(c(BOLD, "  recent events"))
         for ev in events:
             kind = ev.get("event", "?")
-            code = RED if kind in ("ranks_lost", "stall_kill") else (
+            code = RED if kind in ("ranks_lost", "stall_kill",
+                                   "numerics_anomaly") else (
                 YELLOW if kind in ("stall", "chaos_injection") else DIM)
             detail = {k: v for k, v in ev.items()
                       if k not in ("event", "ts_us", "epoch_us")}
@@ -371,6 +411,24 @@ def canned_snapshot():
             sp.labels(stage=stage).observe(v)
     reg.counter("hvd_flight_dumps_total", "c",
                 labels=("reason",)).labels(reason="stall").inc()
+    reg.counter("hvd_numerics_tensors_observed_total", "c").inc(8400)
+    nf = reg.counter("hvd_nonfinite_total", "c",
+                     labels=("tensor", "where"))
+    nf.labels(tensor="grad/dense_7", where="local").inc(3)
+    reg.counter("hvd_numerics_anomalies_total", "c",
+                labels=("kind",)).labels(kind="nonfinite").inc()
+    reg.counter("hvd_coordinator_numerics_anomalies_total", "c",
+                labels=("kind",)).labels(kind="divergence").inc()
+    reg.gauge("hvd_numerics_divergent_rank", "g").set(1)
+    gn = reg.gauge("hvd_grad_norm", "g", labels=("tensor",))
+    gd = reg.gauge("hvd_grad_norm_drift", "g", labels=("tensor",))
+    for tensor, norm, d in (("grad/dense_7", 812.4, 6.1),
+                            ("grad/embed", 2.31, 1.0)):
+        gn.labels(tensor=tensor).set(norm)
+        gd.labels(tensor=tensor).set(d)
+    reg.gauge("hvd_compression_norm_delta", "g",
+              labels=("tensor", "compressor")).labels(
+        tensor="grad/embed", compressor="fp16").set(3.1e-4)
     reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",
               trace_id="r1.42", dur_ms=412.5, status="ok")
     reg.event("stall", tensor="grad/dense_7", missing_ranks=[3],
@@ -378,6 +436,9 @@ def canned_snapshot():
     reg.event("chaos_injection", fault="drop_response",
               service="hvd.negotiation", message="CycleResponse",
               rule="demo", count=5)
+    reg.event("numerics_anomaly", anomaly="divergence",
+              tensor="grad/dense_7", cycle=42, divergent_rank=1,
+              first_bad_cycle=42, trace_id="r1.42")
     snap = reg.snapshot()
     snap["ranks"] = [0, 1]
     return snap

@@ -21,7 +21,7 @@ def _build_parser():
     p = argparse.ArgumentParser(
         prog="python -m tools.hvdlint",
         description="distributed-correctness lint for horovod_tpu "
-                    "(rules HVD001..HVD008; HVD000 = lint integrity)")
+                    "(rules HVD001..HVD009; HVD000 = lint integrity)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to scan (default: %s)" %
                         " ".join(DEFAULT_PATHS))

@@ -851,6 +851,43 @@ def check_span_leak(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD009 — ad-hoc numerics probe
+# ---------------------------------------------------------------------------
+
+# the isnan family: any call whose terminal attribute (jnp.isnan,
+# np.isfinite, math.isinf, jax.numpy.nan_to_num) or bare imported name
+# is one of these is gradient-health math and belongs in the sanctioned
+# module
+_NUMERICS_PROBE_NAMES = {"isnan", "isinf", "isfinite", "isposinf",
+                         "isneginf", "nan_to_num"}
+_NUMERICS_SANCTIONED_SUFFIXES = ("horovod_tpu/utils/numerics.py",)
+
+
+def check_adhoc_numerics(ctx, shared):
+    if ctx.relpath.endswith(_NUMERICS_SANCTIONED_SUFFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            probe = node.func.id
+        else:
+            chain = _attr_chain(node.func)
+            probe = chain[-1] if chain else None
+        if probe in _NUMERICS_PROBE_NAMES:
+            yield Finding(
+                "HVD009", ctx.relpath, node.lineno, node.col_offset,
+                f"ad-hoc numerics probe '{probe}(...)': gradient-health "
+                "math outside utils/numerics.py. Per-tensor nan/inf and "
+                "norm checks must ride the fused one-pass stats path "
+                "(utils/numerics.py tensor_stats/segment_stats, or "
+                "fusion.bucket_stats) so the <=2% overhead contract and "
+                "the cross-rank digest stay honest — a stray isnan scan "
+                "is a second full pass over the gradient and its result "
+                "never reaches the divergence sentinel.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1029,5 +1066,40 @@ lexical extents; for spans that outlive the function, store them on the
 owning object and audit every terminal path (success, error, shutdown)
 for a close()/abort().""",
             check_span_leak),
+        Rule(
+            "HVD009", "ad-hoc-numerics-probe",
+            "isnan-family call outside the sanctioned numerics module",
+            """HVD009 — ad-hoc numerics probe
+
+The numerics plane (utils/numerics.py) computes every per-tensor
+gradient-health statistic — L2 norm, max-abs, nan/inf counts, zero
+fraction, checksum — as a single fused pass over buffers the collective
+already materialized, and folds the results into the cross-rank digest
+the coordinator's divergence sentinel compares. That design carries two
+contracts: the stats cost <=2% end-to-end (enforced by the bench.py
+numerics leg), and every health signal reaches the digest so the
+sentinel can name the divergent rank.
+
+An ad-hoc ``jnp.isnan(grad).any()`` sprinkled at a call site breaks
+both. It is a second full read of the gradient (a separate kernel
+launch, uncounted by the overhead gate), it runs at trace time inside
+jitted code unless carefully guarded (see HVD007), and its verdict
+stays local — the coordinator never sees it, so the one rank that
+noticed the NaN logs a line while the postmortem blames nobody. The
+historical shape: debugging probes added during an incident that stick
+around, each one cheap alone, collectively doubling the flush path's
+memory traffic.
+
+Flags calls to the isnan family (isnan/isinf/isfinite/isposinf/
+isneginf/nan_to_num — any receiver: jnp, np, math, jax.numpy, or a
+bare imported name) in every module except utils/numerics.py.
+
+Fix: route the check through the numerics plane —
+``utils.numerics.tensor_stats`` / ``stats_vector`` for one tensor,
+``segment_stats`` (or ``fusion.bucket_stats``) for a fused buffer —
+and read the verdict from the monitor's records or the
+``hvd_nonfinite_total`` counter. Tests and examples are outside the
+lint scope and may assert finiteness directly.""",
+            check_adhoc_numerics),
     ]
 }
