@@ -430,6 +430,150 @@ def _bench_numerics_overhead(tensors=64, elems=1024, steps=6, rounds=3,
     return out
 
 
+def _bench_quant(hvd, on_tpu):
+    """Quantized-wire A/B gate (docs/compression.md): three arms —
+    none / bf16 / int8 — of the SAME real eager LM step
+    (bench_common.build_eager_lm_step, the exact path users run with
+    --eager-allreduce), toggled live through the coordinator's config
+    (the plan cache keys on the codec fingerprint, so each toggle
+    rebuilds the plan once and then runs steady-state).
+
+    Three enforced numbers (AssertionError past budget, same contract
+    as the flight/numerics gates):
+
+      * wire bytes: int8 must move >=1.8x fewer encoded bytes than bf16
+        for the same steps, read from the hvd_wire_bytes_total counters
+        the data plane itself accounts — not a formula;
+      * convergence: a fresh model trained conv_steps on the int8 wire
+        (error feedback on) must land within 5%% of the full-width
+        final loss, same PRNGKey(0) init on both arms;
+      * none overhead: the only work this machinery adds to an
+        uncompressed flush is one config fingerprint plus a per-tensor
+        select_codec on plan build — measured host-side and bounded at
+        <=2%% of the none arm's step time.
+
+    Arm order is counterbalanced across rounds (none,bf16,int8 then
+    reversed) with an untimed toggle-warmup step, so machine drift is
+    common-mode — the r5 interleaved protocol."""
+    import time
+
+    import jax
+
+    import horovod_tpu.common.state as state
+    from bench_common import build_eager_lm_step, flagship_config
+    from horovod_tpu.ops import quantization as quant_mod
+    from horovod_tpu.utils import metrics as hvd_metrics
+
+    coord = state.global_state().coordinator
+    cfg = coord._config
+    orig = (cfg.compression, cfg.quant_min_bytes)
+    reg = hvd_metrics.get_registry()
+
+    if on_tpu:
+        t_cfg = flagship_config(True, num_layers=4)
+        bps, seq, steps, rounds, conv_steps = 4, 512, 6, 3, 30
+    else:
+        t_cfg = flagship_config(False)
+        bps, seq, steps, rounds, conv_steps = 2, 64, 3, 2, 20
+    world = hvd.size()
+    arms = ("none", "bf16", "int8")
+
+    def wire_totals(codec):
+        m = reg.snapshot(max_events=0).get("metrics", {})
+
+        def total(fam_name):
+            fam = m.get(fam_name) or {"values": []}
+            return sum(float(v["value"]) for v in fam["values"]
+                       if v["labels"].get("codec") == codec)
+
+        return total("hvd_wire_bytes_total"), total("hvd_wire_raw_bytes_total")
+
+    out = {"world": world, "steps_per_window": steps, "rounds": rounds,
+           "conv_steps": conv_steps, "arms": {}}
+    try:
+        cfg.quant_min_bytes = 1024
+        step, params, opt, toks = build_eager_lm_step(t_cfg, world, bps,
+                                                      seq)
+        best = {a: float("inf") for a in arms}
+        wire, raw = {}, {}
+        for rd in range(rounds):
+            for a in (arms if rd % 2 == 0 else arms[::-1]):
+                cfg.compression = a
+                coord._ef.reset()
+                # untimed toggle warmup: plan rebuild + encode compiles
+                params, opt, loss = step(params, opt, toks)
+                float(loss)
+                if rd == 0:
+                    w0, r0 = wire_totals(a)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    params, opt, loss = step(params, opt, toks)
+                float(loss)
+                best[a] = min(best[a],
+                              (time.perf_counter() - t0) / steps * 1e3)
+                if rd == 0:
+                    w1, r1 = wire_totals(a)
+                    wire[a], raw[a] = w1 - w0, r1 - r0
+        for a in arms:
+            out["arms"][a] = {
+                "best_step_ms": round(best[a], 3),
+                "wire_mb_per_window": round(wire[a] / 2**20, 3),
+                "raw_mb_per_window": round(raw[a] / 2**20, 3)}
+
+        # convergence: fresh identical init per arm, EF carrying the
+        # int8 rounding across steps
+        conv = {}
+        for a in ("none", "int8"):
+            cfg.compression = a
+            coord._ef.reset()
+            s2, p2, o2, tk2 = build_eager_lm_step(t_cfg, world, bps, seq)
+            loss = None
+            for _ in range(conv_steps):
+                p2, o2, loss = s2(p2, o2, tk2)
+            conv[a] = float(loss)
+        s2 = p2 = o2 = tk2 = None
+        loss_rel = (abs(conv["int8"] - conv["none"])
+                    / max(abs(conv["none"]), 1e-6))
+
+        # none-path overhead: fingerprint + per-tensor codec selection,
+        # the only host work added when compression is off (and only on
+        # plan-cache misses; this bounds the worst case of one rebuild
+        # per step)
+        cfg.compression = "none"
+        n_tensors = len(jax.tree_util.tree_leaves(params))
+        reps = 200
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            quant_mod.config_fingerprint(cfg)
+            for _ in range(n_tensors):
+                quant_mod.select_codec(cfg, "float32", 1 << 20)
+        sel_ms = (time.perf_counter() - t0) / reps * 1e3
+        none_overhead_pct = sel_ms / best["none"] * 100.0
+
+        wire_ratio = wire["bf16"] / max(wire["int8"], 1.0)
+        out.update({
+            "wire_ratio_int8_vs_bf16": round(wire_ratio, 3),
+            "loss_none": round(conv["none"], 5),
+            "loss_int8_ef": round(conv["int8"], 5),
+            "loss_rel_diff": round(loss_rel, 5),
+            "none_select_overhead_pct": round(none_overhead_pct, 4)})
+        assert wire["int8"] > 0 and wire["bf16"] > 0, (
+            f"quantized arms moved no accounted wire bytes: {out}")
+        assert wire_ratio >= 1.8, (
+            f"int8 wire reduction {wire_ratio:.2f}x vs bf16 is under "
+            f"the 1.8x budget: {out}")
+        assert loss_rel <= 0.05, (
+            f"quantized-path loss diverged {loss_rel * 100:.1f}% from "
+            f"full width (EF on): {out}")
+        assert none_overhead_pct <= 2.0, (
+            f"codec selection costs {none_overhead_pct:.2f}% of an "
+            f"uncompressed step, over the 2% budget: {out}")
+    finally:
+        cfg.compression, cfg.quant_min_bytes = orig
+        coord._ef.reset()
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -598,6 +742,13 @@ def main():
     numerics = None
     if os.environ.get("HVD_BENCH_NUMERICS", "") != "0":
         numerics = _bench_numerics_overhead()
+    # Quantized-wire A/B gate: int8 vs bf16 encoded bytes (>=1.8x),
+    # EF convergence vs full width, and the none-path selection budget,
+    # all on the real eager LM step. Enforced (AssertionError);
+    # HVD_BENCH_QUANT=0 skips it.
+    quant = None
+    if os.environ.get("HVD_BENCH_QUANT", "") != "0":
+        quant = _bench_quant(hvd, on_tpu)
 
     image_size = 224 if on_tpu else 64
     # Largest per-chip batch that compiles+runs wins MXU utilization; fall
@@ -753,6 +904,7 @@ def main():
         "profile": profile,
         "flight_recorder": flight,
         "numerics": numerics,
+        "quant": quant,
         "metrics": metrics_snap,
     }))
     return 0

@@ -53,6 +53,15 @@ echo "--- numerics (fast fail: stats math, anomaly policy, divergence sentinel)"
 # rank drill stays with the other drills in test_chaos_plane.py.
 python -m pytest tests/test_numerics.py -q -m "not slow"
 
+echo "--- quantization kernels (fast fail: block encode/decode, EF, codec registry)"
+# The quantized wire (docs/compression.md) reduces every gradient's
+# bytes when HVD_COMPRESSION is set; a broken encode/decode or a
+# codec-registry asymmetry corrupts sums on every rank at once. The
+# kernel suite is process-local jit math (round-trip bounds vs numpy,
+# EF convergence on a toy quadratic, digest determinism) and runs in
+# seconds; the multi-process codec-mismatch drill rides the full suite.
+python -m pytest tests/test_quantization.py -q -m "not slow"
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

@@ -105,6 +105,24 @@ class HorovodConfig:
     # reference coordinator's parameter broadcast,
     # parameter_manager.cc:66-81).
     autotune_sync_collectives: int = 32
+    # Quantized wire (ops/quantization.py, docs/compression.md): the
+    # codec gradient allreduces cross the wire in. "none" keeps full
+    # width; "bf16"/"fp16" cast; "int8"/"fp8" are block-scaled with a
+    # per-block max-abs f32 scale. Selection is per tensor (floating
+    # dtype, >= quant_min_bytes) and — under negotiation — decided by
+    # the coordinator from rank 0's config, with a per-cycle
+    # fingerprint check that fails loudly if any rank's knobs differ.
+    compression: str = "none"
+    # Elements per quantization block (one f32 scale each; the scale
+    # overhead is 4/quant_block bytes per element).
+    quant_block: int = 256
+    # Tensors smaller than this stay full width: the encode + scale
+    # overhead beats the wire saving on tiny buffers.
+    quant_min_bytes: int = 1024
+    # Error feedback: carry each encode's rounding error into the next
+    # step's buffer. Leave on — it is what preserves convergence at
+    # int8/fp8 width.
+    quant_ef: bool = True
     # Hierarchical (two-level ICI/DCN) collectives.
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
@@ -134,6 +152,11 @@ class HorovodConfig:
             chaos_spec=env_str("CHAOS_SPEC", "") or "",
             chaos_seed=env_int("CHAOS_SEED", 0),
             chaos_delay_ms=env_float("CHAOS_DELAY_MS", 50.0),
+            compression=(env_str("COMPRESSION", "none") or "none")
+            .strip().lower(),
+            quant_block=env_int("QUANT_BLOCK", 256),
+            quant_min_bytes=env_int("QUANT_MIN_BYTES", 1024),
+            quant_ef=env_bool("QUANT_EF", True),
             metrics_port=env_int("METRICS_PORT", 0),
             metrics_interval=env_float("METRICS_INTERVAL", 5.0),
             autotune=env_bool("AUTOTUNE", False),
@@ -182,6 +205,9 @@ ENV_REGISTRY = (
     ("HOROVOD_CHAOS_SPEC", True, None, "common/config.py",
      "Chaos-plane fault spec (run/chaos.py grammar); unset disables "
      "injection."),
+    ("HOROVOD_COMPRESSION", True, "none", "common/config.py",
+     "Wire codec for gradient allreduces (none, fp16, bf16, int8, "
+     "fp8); quantized codecs are negotiated per tensor."),
     ("HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS", True, "0.0",
      "common/config.py",
      "Worker self-terminates after this long without coordinator "
@@ -232,6 +258,15 @@ ENV_REGISTRY = (
      "digest records."),
     ("HOROVOD_NUMERICS_WARMUP", True, "5", "utils/numerics.py",
      "Per-tensor observations before the norm-spike policy arms."),
+    ("HOROVOD_QUANT_BLOCK", True, "256", "common/config.py",
+     "Elements per block-scaled quantization block (one f32 scale "
+     "each)."),
+    ("HOROVOD_QUANT_EF", True, "1", "common/config.py",
+     "Error feedback for quantized codecs: carry encode rounding "
+     "error into the next step (set 0 to disable)."),
+    ("HOROVOD_QUANT_MIN_BYTES", True, "1024", "common/config.py",
+     "Tensors smaller than this many bytes skip the quantized wire "
+     "and stay full width."),
     ("HOROVOD_RANK_LOST_TIMEOUT_SECONDS", True, "0.0",
      "common/config.py",
      "Coordinator declares a silent rank lost after this long "
@@ -326,6 +361,9 @@ ENV_REGISTRY = (
      "Set 0 to skip the flight-recorder overhead gate in bench.py."),
     ("HVD_BENCH_NUMERICS", False, None, "bench.py",
      "Set 0 to skip the numerics-overhead gate in bench.py."),
+    ("HVD_BENCH_QUANT", False, None, "bench.py",
+     "Set 0 to skip the quantized-wire bench leg (int8 vs bf16 wire "
+     "bytes + none-codec overhead gate)."),
     ("HVD_TEST_WORKERS", False, "auto", "ci/run_tests.sh",
      "pytest-xdist worker count for the CI suite."),
 )

@@ -61,6 +61,8 @@ from ..utils import metrics as hvd_metrics
 from ..utils import numerics as hvd_numerics
 from ..utils import timeline as timeline_mod
 from ..utils import tracing as hvd_tracing
+from . import compression as compression_mod
+from . import quantization as quant_mod
 
 ALLREDUCE = "allreduce"
 ALLGATHER = "allgather"
@@ -340,6 +342,15 @@ class EagerCoordinator:
         self._numerics_pending = None  # digest awaiting piggyback
         self._numerics_cycle = None    # seq being executed (None: local)
         self._numerics_staged = None   # fused-bucket stats matrix
+        # Error-feedback residuals for the quantized wire codecs
+        # (ops/quantization.py): per fused bucket, keyed by member names
+        self._ef = quant_mod.ErrorFeedback()
+        # validate HVD_COMPRESSION at init, not mid-step: an unknown or
+        # unavailable codec name must raise here — never silently fall
+        # back to full width (the negotiation fingerprint would still
+        # agree, but the operator asked for bytes they aren't getting)
+        compression_mod.Compression.from_name(
+            getattr(self._config, "compression", "none"))
         self._m_neg_cycles = reg.counter(
             "hvd_negotiation_cycles_total",
             "Negotiation cycle RPCs completed by this worker.")
@@ -532,7 +543,9 @@ class EagerCoordinator:
                 e.span.close(local=True)
         t0 = time.perf_counter()
         # the plan depends on the (possibly autotuned) fusion threshold
+        # and on the codec knobs (the bench toggles compression live)
         key = (int(self._config.fusion_threshold),
+               quant_mod.config_fingerprint(self._config),
                tuple(e.signature() for e in batch))
         plan = self.plan_cache.get(key)
         if plan is None:
@@ -588,29 +601,41 @@ class EagerCoordinator:
                    if e.op == ALLREDUCE and e.kind == "stacked"]
         if fusable:
             leaves = [batch[i].tensor for i in fusable]
-            # bucket per (dtype, average) in submission order
+            # bucket per (dtype, average, wire codec) in submission
+            # order — codec selection mirrors the coordinator's
+            # (quantization.select_codec on per-rank tensor bytes)
+            world = max(self._world, 1)
             by_key = collections.OrderedDict()
             for i in fusable:
                 e = batch[i]
-                by_key.setdefault((str(e.tensor.dtype), e.average),
-                                  []).append(i)
-            for (_, average), idxs in by_key.items():
+                codec = quant_mod.select_codec(
+                    self._config, e.tensor.dtype,
+                    _entry_nbytes(e) // world)
+                by_key.setdefault(
+                    (str(e.tensor.dtype), e.average, codec), []).append(i)
+            for (_, average, codec), idxs in by_key.items():
                 buckets = fusion_mod.plan_buckets(
                     [batch[i].tensor for i in idxs],
                     self._config.fusion_threshold)
                 for b in buckets:
                     groups.append(("fused_allreduce",
-                                   [idxs[j] for j in b.indices], average))
+                                   [idxs[j] for j in b.indices], average,
+                                   codec))
         for i, e in enumerate(batch):
             if e.op == ALLREDUCE and e.kind == "stacked":
                 continue
-            groups.append((e.op + ":" + e.kind, [i], e.average))
+            codec = None
+            if e.op == ALLREDUCE and e.kind == "replicated":
+                codec = quant_mod.select_codec(
+                    self._config, getattr(e.tensor, "dtype", None),
+                    _entry_nbytes(e))
+            groups.append((e.op + ":" + e.kind, [i], e.average, codec))
         return groups
 
     def _execute(self, batch, plan):
         mon = hvd_numerics.get_monitor()
         observed = []
-        for kind, idxs, average in plan:
+        for kind, idxs, average, codec in plan:
             entries = [batch[i] for i in idxs]
             t0 = time.perf_counter()
             lead = entries[0]
@@ -619,10 +644,11 @@ class EagerCoordinator:
                 trace_id=lead.trace_id, op=lead.op, fused=len(entries))
             try:
                 if kind == "fused_allreduce":
-                    self._exec_fused_stacked_allreduce(entries, average)
+                    self._exec_fused_stacked_allreduce(entries, average,
+                                                       codec)
                 else:
                     op, entry_kind = kind.split(":")
-                    self._exec_single(entries[0], op, entry_kind)
+                    self._exec_single(entries[0], op, entry_kind, codec)
                 for e in entries:
                     e.status = True
                 op_class = entries[0].op
@@ -752,11 +778,12 @@ class EagerCoordinator:
         digest, self._numerics_pending = self._numerics_pending, None
         t0 = time.perf_counter()
         try:
-            resp = self._negotiator.cycle(metas, self._applied_seq,
-                                          req_id=self._cycle_req_id,
-                                          hits=neg.encode_hits(hit_ids),
-                                          metrics=push, flight=flight,
-                                          digest=digest)
+            resp = self._negotiator.cycle(
+                metas, self._applied_seq,
+                req_id=self._cycle_req_id,
+                hits=neg.encode_hits(hit_ids),
+                metrics=push, flight=flight, digest=digest,
+                codec_fp=quant_mod.config_fingerprint(self._config))
         # hvdlint: disable=HVD006(retried next cycle; counted in hvd_negotiation_failures and escalated by liveness fail-fast)
         except Exception as exc:  # noqa: BLE001 — transient TCP hiccups
             self._unannounced = (metas, hit_ids)
@@ -1004,12 +1031,17 @@ class EagerCoordinator:
                     for e in entries:
                         self._tensor_table.pop(e.name, None)
                         e.event.set()
-            elif r.op == ALLREDUCE and len(entries) > 1:
+            elif r.op == ALLREDUCE and (
+                    len(entries) > 1 or getattr(r, "codec", None)):
+                # singles with a negotiated wire codec also route through
+                # the fused path: it owns the encode/EF machinery and is
+                # the identity concat for one entry
                 executed_bytes += sum(_entry_nbytes(e) for e in entries)
+                codec = getattr(r, "codec", None)
                 self._finish_entries(
                     entries,
-                    lambda es: self._exec_fused_replicated_allreduce(
-                        es, es[0].average))
+                    lambda es, c=codec: self._exec_fused_replicated_allreduce(
+                        es, es[0].average, c))
             elif r.op == ALLGATHER and len(entries) > 1:
                 executed_bytes += sum(_entry_nbytes(e) for e in entries)
                 self._finish_entries(
@@ -1070,13 +1102,17 @@ class EagerCoordinator:
         from .process_collectives import ProcessCollectiveEngine
         return ProcessCollectiveEngine()
 
-    def _exec_fused_replicated_allreduce(self, entries, average):
+    def _exec_fused_replicated_allreduce(self, entries, average,
+                                         codec=None):
         """Coordinator-fused multi-process allreduce: one flattened
         buffer, ONE cross-process device-side collective for the whole
         bucket (MPIAllreduce's fusion-buffer memcpy-in/allreduce/
         memcpy-out, mpi_operations.cc:25-66, on the process axis).
         Concat, psum, and un-fuse slicing all happen on device — the
-        host never stages the payload."""
+        host never stages the payload. ``codec`` is the negotiated wire
+        codec from the CycleResponse plan (ops/quantization.py): a
+        quantized codec runs the two-phase encoded collective with
+        error feedback; a cast codec narrows the buffer for the psum."""
         tl = self.timeline
         names = [e.name for e in entries]
         if tl:
@@ -1088,9 +1124,45 @@ class EagerCoordinator:
             for n in names:
                 tl.end_activity(n)
                 tl.start_activity(n, timeline_mod.ALLREDUCE)
-        with jax.profiler.TraceAnnotation(
-                f"hvd.fused_allreduce.x{len(entries)}"):
-            summed = self._proc_engine.allreduce(fused, average=average)
+        if codec is not None and quant_mod.is_quantized(codec):
+            block = int(getattr(self._config, "quant_block",
+                                quant_mod.BLOCK_DEFAULT))
+            ef_on = bool(getattr(self._config, "quant_ef", True))
+            key = "|".join(names)
+            total = int(fused.shape[0])
+            comp = self._ef.compensate(key, fused) if ef_on else fused
+            nproc = jax.process_count()
+            payload, scales = quant_mod.encode(
+                comp, block, codec, multiple=block * nproc)
+            with jax.profiler.TraceAnnotation(
+                    f"hvd.quantized_allreduce.{codec}.x{len(entries)}"):
+                summed = self._proc_engine.allreduce_quantized(
+                    payload, scales, codec, block,
+                    average=average)[:total].astype(fused.dtype)
+            # this rank's own wire contribution as the peers saw it —
+            # the error-feedback reference and the numerics plane's
+            # post-compression side
+            dec_own = quant_mod.decode(payload, scales, block, total)
+            if ef_on:
+                self._ef.update(key, comp, dec_own, block,
+                                anchor=names[0])
+            quant_mod.account(codec, fused.nbytes,
+                              quant_mod.wire_nbytes(payload, scales))
+            mon = hvd_numerics.get_monitor()
+            if mon.enabled:
+                mon.observe_compression(names[0], comp, dec_own, codec)
+        elif codec is not None:
+            wire = fused.astype(quant_mod.wire_dtype(codec))
+            with jax.profiler.TraceAnnotation(
+                    f"hvd.fused_allreduce.{codec}.x{len(entries)}"):
+                summed = self._proc_engine.allreduce(
+                    wire, average=average).astype(fused.dtype)
+            quant_mod.account(codec, fused.nbytes, wire.nbytes)
+        else:
+            with jax.profiler.TraceAnnotation(
+                    f"hvd.fused_allreduce.x{len(entries)}"):
+                summed = self._proc_engine.allreduce(fused, average=average)
+            quant_mod.account(None, fused.nbytes, fused.nbytes)
         if hvd_numerics.get_monitor().enabled:
             # fused side-product: per-slice health stats in one segment
             # pass over the buffers the collective already materialized;
@@ -1223,10 +1295,14 @@ class EagerCoordinator:
         the all-gather leg a ring allreduce ends with anyway."""
         return jax.jit(lambda x: x, out_shardings=self._sharding(P()))
 
-    def _exec_fused_stacked_allreduce(self, entries, average):
+    def _exec_fused_stacked_allreduce(self, entries, average, codec=None):
         """Fuse [world, n_i] tensors into one [world, total] buffer, one
         psum, split back (MPIAllreduce memcpy-in/allreduce/memcpy-out,
-        mpi_operations.cc:25-66)."""
+        mpi_operations.cc:25-66). ``codec`` is the wire codec from the
+        plan (ops/quantization.py): quantized codecs run the simulated
+        stacked wire (each row encoded as its own contribution, f32
+        accumulation, error feedback) so single-process runs see the
+        exact numerics of the cross-process encoded collective."""
         tl = self.timeline
         names = [e.name for e in entries]
         if tl:
@@ -1240,9 +1316,41 @@ class EagerCoordinator:
             for n in names:
                 tl.end_activity(n)
                 tl.start_activity(n, timeline_mod.ALLREDUCE)
-        summed = self._replicate(self._stacked_psum(fused))
-        if average:
-            summed = summed / self._world
+        if codec is not None and quant_mod.is_quantized(codec):
+            block = int(getattr(self._config, "quant_block",
+                                quant_mod.BLOCK_DEFAULT))
+            ef_on = bool(getattr(self._config, "quant_ef", True))
+            key = "|".join(names)
+            total = int(fused.shape[1])
+            comp = self._ef.compensate(key, fused) if ef_on else fused
+            with jax.profiler.TraceAnnotation(
+                    f"hvd.quantized_allreduce.{codec}.x{len(entries)}"):
+                summed, dec_rows = quant_mod.stacked_wire_allreduce(
+                    comp, block, codec, bool(average), total)
+            # rows are identical; replicate for the same output
+            # sharding as the psum path
+            summed = self._replicate(summed.astype(fused.dtype))
+            if ef_on:
+                self._ef.update(key, comp, dec_rows, block,
+                                anchor=names[0])
+            quant_mod.account(
+                codec, fused.nbytes,
+                self._world * quant_mod.encoded_nbytes(total, codec, block))
+            mon = hvd_numerics.get_monitor()
+            if mon.enabled:
+                mon.observe_compression(names[0], comp, dec_rows, codec)
+        elif codec is not None:
+            wire = fused.astype(quant_mod.wire_dtype(codec))
+            summed = self._replicate(
+                self._stacked_psum(wire)).astype(fused.dtype)
+            if average:
+                summed = summed / self._world
+            quant_mod.account(codec, fused.nbytes, wire.nbytes)
+        else:
+            summed = self._replicate(self._stacked_psum(fused))
+            if average:
+                summed = summed / self._world
+            quant_mod.account(None, fused.nbytes, fused.nbytes)
         if tl:
             for n in names:
                 tl.end_activity(n)
@@ -1258,7 +1366,7 @@ class EagerCoordinator:
                 tl.end_activity(n)
         return entries
 
-    def _exec_single(self, entry, op, entry_kind):
+    def _exec_single(self, entry, op, entry_kind, codec=None):
         tl = self.timeline
         if tl:
             tl.start_activity(entry.name, op.upper())
@@ -1297,7 +1405,15 @@ class EagerCoordinator:
             # (utils/timeline.py profile(); SURVEY "timeline fidelity")
             with jax.profiler.TraceAnnotation(f"hvd.{op}.{entry.name}"):
                 if op == ALLREDUCE:
-                    entry.result = self._allreduce_one(entry, entry_kind)
+                    if codec is not None and entry_kind == "replicated":
+                        # wire codec selected for this tensor: the fused
+                        # path owns the encode/EF machinery and is the
+                        # identity concat for one entry
+                        self._exec_fused_replicated_allreduce(
+                            [entry], entry.average, codec)
+                    else:
+                        entry.result = self._allreduce_one(entry,
+                                                           entry_kind)
                 elif op == ALLGATHER:
                     entry.result = self._allgather_one(entry, entry_kind)
                 elif op == BROADCAST:

@@ -159,7 +159,13 @@ def decode_hits(data):
 # it misparse fields. Workers from builds predating this encoding fail
 # equally loudly: their unpickle cannot resolve decode_response at all.
 
-RESPONSE_WIRE_VERSION = 1
+#
+# Version history: 2 added the per-response wire-codec field (header
+# bit 5 + string) carrying the negotiated quantized-allreduce codec —
+# a plan field every rank must agree on, hence the version bump rather
+# than an optional flag a stale build would silently ignore.
+
+RESPONSE_WIRE_VERSION = 2
 
 # op enum for the wire; index 0 is reserved for "op carried as a string"
 # so an op this table doesn't know (a newer build's) still round-trips
@@ -224,10 +230,12 @@ def encode_response(resp):
             op_i = _WIRE_OPS.index(r.op) + 1
         except ValueError:
             op_i = 0
-        # one header byte: bit0 kind, bits1-3 op enum, bit4 cache_ids
+        # one header byte: bit0 kind, bits1-3 op enum, bit4 cache_ids,
+        # bit5 wire codec
         out.append((1 if r.kind == NegotiatedResponse.EXECUTE else 0)
                    | (op_i << 1)
-                   | (16 if r.cache_ids is not None else 0))
+                   | (16 if r.cache_ids is not None else 0)
+                   | (32 if r.codec is not None else 0))
         if op_i == 0:
             _put_str(out, r.op)
         _put_varint(out, len(r.names))
@@ -237,6 +245,8 @@ def encode_response(resp):
         if r.cache_ids is not None:
             for cid in r.cache_ids:  # parallel to names, same count
                 _put_varint(out, int(cid))
+        if r.codec is not None:
+            _put_str(out, r.codec)
     payload = bytes(out)
     hvd_metrics.get_registry().counter(
         "hvd_response_wire_bytes_total",
@@ -304,8 +314,12 @@ def decode_response(payload):
             for _ in range(n_names):
                 cid, i = _get_varint(payload, i)
                 cache_ids.append(cid)
+        codec = None
+        if head & 32:
+            codec, i = _get_str(payload, i)
         responses.append(NegotiatedResponse(kind, op, names, error=error,
-                                            cache_ids=cache_ids))
+                                            cache_ids=cache_ids,
+                                            codec=codec))
     return CycleResponse(base_seq, responses, (thr, cyc), bool(flags & 1),
                          stale_ack=bool(flags & 2),
                          dump_requested=bool(flags & 4),
@@ -314,11 +328,18 @@ def decode_response(payload):
 
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
-                 hits=b"", metrics=None, flight=None, digest=None):
+                 hits=b"", metrics=None, flight=None, digest=None,
+                 codec_fp=None):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
         self.shutdown = shutdown
+        # wire-codec config fingerprint (quantization.config_fingerprint):
+        # the coordinator compares it against rank 0's every cycle and
+        # fails negotiation loudly on any asymmetry — a rank encoding
+        # int8 while another decodes bf16 would corrupt sums silently.
+        # Requests are plain-pickled, so the field is wire-safe.
+        self.codec_fp = codec_fp
         # numerics digest piggyback (utils/numerics.py): per-cycle
         # gradient-health records ({"v", "rank", "cycles": {seq: {name:
         # record}}}) for the coordinator's cross-rank divergence
@@ -352,11 +373,12 @@ class CycleRequest:
 class NegotiatedResponse:
     """One unit of agreed work (reference Response, message.h:130)."""
 
-    __slots__ = ("kind", "op", "names", "error", "cache_ids")
+    __slots__ = ("kind", "op", "names", "error", "cache_ids", "codec")
     EXECUTE = "execute"
     ERROR = "error"
 
-    def __init__(self, kind, op, names, error=None, cache_ids=None):
+    def __init__(self, kind, op, names, error=None, cache_ids=None,
+                 codec=None):
         self.kind = kind
         self.op = op
         self.names = names  # >1 names = fused allreduce
@@ -365,6 +387,11 @@ class NegotiatedResponse:
         # riding the seq-ordered response log means every rank learns
         # each assignment at the same point in its apply order
         self.cache_ids = cache_ids
+        # negotiated wire codec for this (fused) allreduce — decided
+        # once by the coordinator from rank 0's config so every rank
+        # encodes/decodes identically (ops/quantization.py); None means
+        # full width. Versioned plan field (wire version 2).
+        self.codec = codec
 
 
 class CycleResponse:
@@ -411,6 +438,22 @@ def _meta_identical(a, b):
     by the cached meta)."""
     return (a.name, a.op, a.dtype, a.shape, a.root_rank, a.average) == \
         (b.name, b.op, b.dtype, b.shape, b.root_rank, b.average)
+
+
+def _meta_nbytes(meta):
+    """Payload bytes an EntryMeta describes — the size gate for
+    wire-codec selection (the counterpart of fusion._nbytes, which
+    works on real leaves)."""
+    n = 1
+    for d in meta.shape:
+        n *= int(d)
+    try:
+        import numpy as np
+        return n * np.dtype(meta.dtype).itemsize
+    except TypeError:
+        # a dtype string numpy can't resolve (no ml_dtypes): assume
+        # 4-byte elements rather than failing negotiation over a gate
+        return n * 4
 
 
 class _TableRow:
@@ -491,6 +534,14 @@ class CoordinatorService(network.BasicService):
         # digest arrives), and the flag upgrades once a culprit is known
         self._numerics_flagged = {}
         self._numerics_first_bad = {}   # tensor -> first bad cycle
+        # wire-codec agreement: rank 0's codec-config fingerprint is the
+        # negotiated truth; any rank whose piggybacked fingerprint
+        # differs is recorded here and every subsequently ready tensor
+        # becomes an ERROR response — the loud failure that replaces a
+        # silently corrupted quantized sum (ops/quantization.py)
+        from . import quantization
+        self._codec_fp = quantization.config_fingerprint(config)
+        self._codec_mismatch = {}       # rank -> offending fingerprint
         reg = self._metrics = hvd_metrics.get_registry()
         self._m_cycles = reg.counter(
             "hvd_coordinator_cycles_total",
@@ -561,6 +612,19 @@ class CoordinatorService(network.BasicService):
                         self.flight_dumps[req.rank] = path
                 if getattr(req, "digest", None) is not None:
                     self._numerics_scan(req.rank, req.digest)
+                fp = getattr(req, "codec_fp", None)
+                if (fp is not None and fp != self._codec_fp
+                        and req.rank not in self._codec_mismatch):
+                    self._codec_mismatch[req.rank] = fp
+                    self._metrics.event(
+                        "codec_mismatch", rank=req.rank, theirs=fp,
+                        ours=self._codec_fp)
+                    log.error(
+                        "negotiation: rank %d wire-codec config %r "
+                        "differs from rank 0's %r — failing its "
+                        "collectives (HVD_COMPRESSION / HVD_QUANT_* "
+                        "must agree on every rank)",
+                        req.rank, fp, self._codec_fp)
                 self._last_seen[req.rank] = time.monotonic()
                 self._acks[req.rank] = max(
                     self._acks.get(req.rank, -1), req.ack)
@@ -707,6 +771,27 @@ class CoordinatorService(network.BasicService):
                     error=f"Horovod has been shut down: {op} '{name}' "
                           "became ready after a rank requested shutdown."))
             return
+        if self._codec_mismatch:
+            # rank-asymmetric codec config: EXECUTE responses here would
+            # have ranks encoding/decoding different wire formats into
+            # the same sum. Fail every ready tensor loudly instead.
+            detail = ", ".join(
+                f"process {r} has '{self._codec_mismatch[r]}'"
+                for r in sorted(self._codec_mismatch))
+            for name in ready:
+                row = self._table.pop(name)
+                op = next(iter(row.metas.values())).op
+                self._responses.append(NegotiatedResponse(
+                    NegotiatedResponse.ERROR, op, [name],
+                    error=(
+                        f"Mismatched wire-codec config across processes "
+                        f"for {op} '{name}': process 0 negotiates "
+                        f"'{self._codec_fp}' but {detail}. "
+                        "HVD_COMPRESSION and the HVD_QUANT_* knobs must "
+                        "be identical on every rank; a quantized "
+                        "allreduce under mismatched codecs would corrupt "
+                        "the sums silently.")))
+            return
         checked = []
         for name in ready:
             row = self._table.pop(name)
@@ -735,18 +820,31 @@ class CoordinatorService(network.BasicService):
         # execute as one fused allgatherv with per-rank displacement
         # math (Response::add_allgather_response, message.h:172).
         from . import fusion as fusion_mod
+        from . import quantization
         threshold = self._config.fusion_threshold
         anchors = {}  # first checked-index of a bucket -> member indices
-        for avg in (False, True):
-            idx = [i for i, (_, m) in enumerate(checked)
-                   if m.op == ALLREDUCE and m.average is avg]
-            if not idx:
+        # Allreduces additionally partition by negotiated wire codec
+        # (selected here, from rank 0's config, so the decision is made
+        # exactly once for all ranks): a fused buffer is encoded as one
+        # unit, so its members must share a codec. The fingerprint check
+        # above guarantees every rank's config would have chosen the
+        # same partition.
+        bucket_codec = {}  # anchor index -> codec (None = full width)
+        ar_groups = {}
+        for i, (_, m) in enumerate(checked):
+            if m.op != ALLREDUCE:
                 continue
+            codec = quantization.select_codec(
+                self._config, m.dtype, _meta_nbytes(m))
+            ar_groups.setdefault((m.average, codec or ""), []).append(i)
+        for (avg, codec), idx in sorted(ar_groups.items()):
             buckets = fusion_mod.plan_buckets(
                 [checked[i][1] for i in idx], threshold)
             for b in buckets:
                 members = [idx[j] for j in b.indices]
                 anchors[members[0]] = members
+                if codec:
+                    bucket_codec[members[0]] = codec
         # plan_buckets partitions by dtype internally, so all ready
         # allgathers go through one planning call
         idx = [i for i, (_, m) in enumerate(checked)
@@ -770,7 +868,8 @@ class CoordinatorService(network.BasicService):
             self._responses.append(NegotiatedResponse(
                 NegotiatedResponse.EXECUTE, meta.op,
                 [n for n, _ in named],
-                cache_ids=self._assign_cache_ids(named)))
+                cache_ids=self._assign_cache_ids(named),
+                codec=bucket_codec.get(i)))
 
     def _assign_cache_ids(self, named_metas):
         """Give each EXECUTEd name a cache id (new names and
@@ -1080,11 +1179,12 @@ class NegotiationWorker:
                 time.sleep(0.2)
 
     def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b"",
-              metrics=None, flight=None, digest=None):
+              metrics=None, flight=None, digest=None, codec_fp=None):
         return self._client.request(
             CycleRequest(self._rank, entries, ack, shutdown,
                          req_id=req_id, hits=hits, metrics=metrics,
-                         flight=flight, digest=digest))
+                         flight=flight, digest=digest,
+                         codec_fp=codec_fp))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

@@ -14,6 +14,11 @@ ring/tree implementations over ICI/DCN:
   * allgather      → resharding to replicated (XLA all-gather)
   * reducescatter  → ``lax.psum_scatter``
   * alltoall       → ``lax.all_to_all``
+  * quantized allreduce → two-phase reduce-scatter/all-gather over the
+    narrow wire dtype (ops/quantization.py): all_to_all the encoded
+    payload+scales, dequant→sum in f32, requant the owned chunk,
+    all_gather the narrow sum — so every byte that crosses the wire is
+    int8/fp8 (+ f32 block scales) while accumulation stays f32
 
 Every process must invoke the same engine call in the same order — the
 eager core guarantees that (coordinator-ordered under negotiation,
@@ -32,6 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common import compat
+from . import quantization
 
 PROC_AXIS = "proc"
 
@@ -123,6 +129,46 @@ class ProcessCollectiveEngine:
         return f
 
     @functools.cached_property
+    def _quantized_rs_fn(self):
+        """Phase 1: reduce-scatter over the narrow wire. Every process
+        all_to_alls its encoded contribution, dequants the peer chunks
+        to f32, sums, and requantizes its owned chunk — output is the
+        narrow requantized sum, process-sharded."""
+        mesh = self.mesh
+        nproc = self.nproc
+
+        @functools.partial(jax.jit, static_argnums=(2, 3))
+        def f(q, s, codec, block):
+            # q [nproc, m] narrow payload, s [nproc, m // block] f32
+            # scales; row p is process p's encoded contribution. m must
+            # be a multiple of block * nproc so the per-process chunks
+            # land on block boundaries (encode(multiple=block * nproc)).
+            def body(qs, ss):
+                chunk = qs.shape[-1] // nproc
+                qp = lax.all_to_all(
+                    qs[0].reshape(nproc, chunk), PROC_AXIS,
+                    split_axis=0, concat_axis=0, tiled=True)
+                sp = lax.all_to_all(
+                    ss[0].reshape(nproc, chunk // block), PROC_AXIS,
+                    split_axis=0, concat_axis=0, tiled=True)
+                # accumulate in f32: dequant each peer row, sum, requant
+                total = jnp.sum(
+                    quantization._block_decode(qp, sp, block), axis=0)
+                return quantization._block_encode(total, block, codec)
+            return compat.shard_map(
+                body, mesh=mesh, in_specs=(P(PROC_AXIS), P(PROC_AXIS)),
+                out_specs=(P(PROC_AXIS), P(PROC_AXIS)))(q, s)
+        return f
+
+    @functools.cached_property
+    def _quantized_gather_fn(self):
+        # phase 2: resharding the NARROW payload + scales to replicated
+        # IS the all-gather; XLA moves the encoded bytes, and the final
+        # dequant runs locally on every process
+        return jax.jit(lambda q, s: (q, s),
+                       out_shardings=(self._replicated, self._replicated))
+
+    @functools.cached_property
     def _alltoall_fn(self):
         mesh = self.mesh
 
@@ -141,6 +187,20 @@ class ProcessCollectiveEngine:
         """Sum (or mean) of every process's ``x``; full result on this
         process's device."""
         return self._local(self._allreduce_fn(self._stack(x), bool(average)))
+
+    def allreduce_quantized(self, payload, scales, codec, block,
+                            average=False):
+        """Sum (or mean) across processes of the block-scaled encoded
+        buffers, f32 result on this process's device. ``payload`` length
+        must be a multiple of ``block * nproc``; each process passes its
+        own (payload, scales) from quantization.encode."""
+        q2, s2 = self._quantized_rs_fn(
+            self._stack(payload), self._stack(scales), str(codec),
+            int(block))
+        qg, sg = self._quantized_gather_fn(q2, s2)
+        out = quantization.decode(self._local(qg), self._local(sg),
+                                  int(block), int(qg.shape[0]))
+        return out / self.nproc if average else out
 
     def broadcast(self, x, root):
         """Process ``root``'s ``x`` on every process."""

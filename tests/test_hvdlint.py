@@ -582,6 +582,72 @@ def test_hvd009_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD010 — wire-dtype cast bypasses the codec registry
+# ---------------------------------------------------------------------------
+
+def test_hvd010_triggers_on_direct_int8_cast(tmp_path):
+    found = lint_source(tmp_path, """\
+        import jax.numpy as jnp
+
+        def narrow(grad):
+            return grad.astype(jnp.int8)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD010"]
+    assert "int8" in live(found)[0].message
+
+
+def test_hvd010_triggers_on_string_and_npdtype_forms(tmp_path):
+    found = lint_source(tmp_path, """\
+        import numpy as np
+
+        def narrow(grad, other):
+            a = grad.astype("float8_e4m3fn")
+            b = other.astype(np.dtype("uint8"))
+            return a, b
+        """)
+    assert sorted(f.rule for f in live(found)) == ["HVD010", "HVD010"]
+
+
+def test_hvd010_wide_casts_are_clean(tmp_path):
+    found = lint_source(tmp_path, """\
+        import jax.numpy as jnp
+
+        def widen(grad):
+            # bf16/f32 casts are numerics policy, not wire format
+            return grad.astype(jnp.bfloat16).astype(jnp.float32)
+        """)
+    assert live(found) == []
+
+
+def test_hvd010_sanctioned_quantization_module_is_clean(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "ops"
+    mod.mkdir(parents=True)
+    f = mod / "quantization.py"
+    f.write_text(textwrap.dedent("""\
+        import jax.numpy as jnp
+
+        def encode(x):
+            return x.astype(jnp.int8)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd010_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        import jax.numpy as jnp
+
+        def tokens(ids):
+            return ids.astype(jnp.uint8)  # hvdlint: disable=HVD010(token bytes, not a wire codec)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD010"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -641,7 +707,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD00{i}" for i in range(1, 10)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 11)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

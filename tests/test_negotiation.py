@@ -127,6 +127,100 @@ class TestCoordinatorUnit:
         finally:
             svc.shutdown()
 
+    def _quant_service(self):
+        from horovod_tpu.common.config import HorovodConfig
+        from horovod_tpu.ops import negotiation as neg
+        cfg = HorovodConfig(fusion_threshold=64 << 20,
+                            stall_warning_time_seconds=0,
+                            compression="int8", quant_min_bytes=1024)
+        svc = neg.CoordinatorService(2, b"k" * 32, ports=[0], config=cfg)
+        return svc, neg
+
+    def test_negotiated_plan_carries_per_tensor_codec(self):
+        svc, neg = self._quant_service()
+        try:
+            metas = [self._meta(neg, "big", shape=(1024,)),
+                     self._meta(neg, "small", shape=(4,)),
+                     self._meta(neg, "ints", dtype="int32",
+                                shape=(1024,))]
+            svc._submit(0, metas)
+            svc._submit(1, metas)
+            svc._negotiate()
+            by_names = {tuple(r.names): r for r in svc._responses}
+            # big float tensor rides the quantized wire
+            assert by_names[("big",)].codec == "int8"
+            # under quant_min_bytes: the encode overhead isn't worth it
+            assert by_names[("small",)].codec is None
+            # integer reductions are exact already; never quantized
+            assert by_names[("ints",)].codec is None
+        finally:
+            svc.shutdown()
+
+    def test_codec_splits_fusion_buckets(self):
+        # same dtype, same average — but only one clears the size gate,
+        # so they must NOT share a fused bucket (one wire format per
+        # fusion buffer)
+        svc, neg = self._quant_service()
+        try:
+            metas = [self._meta(neg, "a", shape=(1024,)),
+                     self._meta(neg, "b", shape=(4,)),
+                     self._meta(neg, "c", shape=(2048,))]
+            svc._submit(0, metas)
+            svc._submit(1, metas)
+            svc._negotiate()
+            plans = {tuple(r.names): getattr(r, "codec", None)
+                     for r in svc._responses}
+            assert plans[("a", "c")] == "int8"
+            assert plans[("b",)] is None
+        finally:
+            svc.shutdown()
+
+    def test_codec_fingerprint_mismatch_fails_ready_tensors(self):
+        from horovod_tpu.ops.negotiation import CycleRequest
+        svc, neg = self._quant_service()
+        try:
+            fp0 = svc._codec_fp
+            assert fp0.startswith("int8/")
+            svc._handle(CycleRequest(0, [self._meta(neg, "g",
+                                                    shape=(1024,))],
+                                     ack=-1, codec_fp=fp0),
+                        ("127.0.0.1", 0))
+            svc._handle(CycleRequest(1, [self._meta(neg, "g",
+                                                    shape=(1024,))],
+                                     ack=-1,
+                                     codec_fp="none/b256/min1024/ef1"),
+                        ("127.0.0.1", 0))
+            svc._negotiate()
+            (r,) = svc._responses
+            assert r.kind == r.ERROR
+            assert "Mismatched wire-codec config" in r.error
+            assert "int8" in r.error and "none" in r.error
+            # the mismatch is sticky: later tensors fail too, nothing
+            # ever executes under asymmetric codecs
+            svc._submit(0, [self._meta(neg, "h")])
+            svc._submit(1, [self._meta(neg, "h")])
+            svc._negotiate()
+            assert all(x.kind == x.ERROR for x in svc._responses[1:])
+        finally:
+            svc.shutdown()
+
+    def test_matching_fingerprints_do_not_trip(self):
+        from horovod_tpu.ops.negotiation import CycleRequest
+        svc, neg = self._quant_service()
+        try:
+            for rank in (0, 1):
+                svc._handle(CycleRequest(rank,
+                                         [self._meta(neg, "g",
+                                                     shape=(1024,))],
+                                         ack=-1, codec_fp=svc._codec_fp),
+                            ("127.0.0.1", 0))
+            svc._negotiate()
+            assert not svc._codec_mismatch
+            (r,) = svc._responses
+            assert r.kind == r.EXECUTE and r.codec == "int8"
+        finally:
+            svc.shutdown()
+
 
 class TestResponseWire:
     """Compact CycleResponse encoding (the per-cycle hot message pickles
@@ -139,6 +233,9 @@ class TestResponseWire:
             neg.NegotiatedResponse(
                 neg.NegotiatedResponse.EXECUTE, "allreduce",
                 ["g0", "g1", "g2"], cache_ids=[0, 1, 7]),
+            neg.NegotiatedResponse(
+                neg.NegotiatedResponse.EXECUTE, "allreduce",
+                ["q0", "q1"], codec="int8"),
             neg.NegotiatedResponse(
                 neg.NegotiatedResponse.ERROR, "broadcast", ["bad"],
                 error="Mismatched broadcast 'bad' across processes"),
@@ -159,8 +256,10 @@ class TestResponseWire:
         assert b.lost_ranks == a.lost_ranks
         assert len(b.responses) == len(a.responses)
         for ra, rb in zip(a.responses, b.responses):
-            assert (rb.kind, rb.op, rb.names, rb.error, rb.cache_ids) == \
-                (ra.kind, ra.op, ra.names, ra.error, ra.cache_ids)
+            assert (rb.kind, rb.op, rb.names, rb.error, rb.cache_ids,
+                    rb.codec) == \
+                (ra.kind, ra.op, ra.names, ra.error, ra.cache_ids,
+                 ra.codec)
 
     def test_roundtrip_through_pickle(self):
         import cloudpickle

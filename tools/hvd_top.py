@@ -258,6 +258,17 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         lines.append(f"    fusion fill   mean {fsum / fcount:>6.2f}   "
                      f"buckets {int(_total(snap, 'hvd_fusion_buckets_total')):,}"
                      f"   bytes {_fmt_bytes(_total(snap, 'hvd_fusion_bytes_total'))}")
+    # wire codecs: encoded bytes by codec + the live compression ratio
+    # (ops/quantization.py account(); docs/compression.md)
+    enc = _by_label(snap, "hvd_wire_bytes_total", "codec")
+    if enc:
+        raw = _by_label(snap, "hvd_wire_raw_bytes_total", "codec")
+        mix = "  ".join(
+            f"{k}={_fmt_bytes(v)}"
+            f"(x{raw.get(k, 0) / v:.2f})" if v else f"{k}=0"
+            for k, v in sorted(enc.items()))
+        ratio = _total(snap, "hvd_wire_compression_ratio")
+        lines.append(f"    wire codecs   {mix}   live ratio x{ratio:.2f}")
 
     # robustness
     retries = _total(snap, "hvd_transport_retries_total")
@@ -393,6 +404,15 @@ def canned_snapshot():
         fill.observe(v)
     reg.counter("hvd_fusion_buckets_total", "c").inc(420)
     reg.counter("hvd_fusion_bytes_total", "c").inc(3 << 30)
+    we = reg.counter("hvd_wire_bytes_total", "c", labels=("codec",))
+    we.labels(codec="int8").inc(780 << 20)
+    we.labels(codec="none").inc(512 << 20)
+    wr = reg.counter("hvd_wire_raw_bytes_total", "c", labels=("codec",))
+    wr.labels(codec="int8").inc(3 << 30)
+    wr.labels(codec="none").inc(512 << 20)
+    reg.gauge("hvd_wire_compression_ratio", "g").set(3.94)
+    reg.gauge("hvd_ef_residual_norm", "g", labels=("tensor",)).labels(
+        tensor="grad/embed").set(0.42)
     reg.counter("hvd_transport_retries_total", "c").inc(2)
     reg.counter("hvd_transport_backoff_seconds_total", "c").inc(0.31)
     reg.counter("hvd_chaos_injections_total", "c",

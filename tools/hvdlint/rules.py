@@ -888,6 +888,61 @@ def check_adhoc_numerics(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD010 — wire-dtype cast outside the codec registry
+# ---------------------------------------------------------------------------
+
+# dtypes that only exist as wire/quantization formats in this codebase:
+# a direct .astype() to one of these is an encode, and encodes belong to
+# the codec registry so the negotiated plan stays the single source of
+# truth for what crosses the wire
+_WIRE_DTYPE_NAMES = {"int8", "uint8", "float8_e4m3fn", "float8_e4m3",
+                     "float8_e5m2"}
+_QUANT_SANCTIONED_SUFFIXES = ("horovod_tpu/ops/quantization.py",
+                              "horovod_tpu/ops/compression.py")
+
+
+def _wire_dtype_of(node):
+    """The wire-dtype name an astype argument resolves to, if any:
+    jnp.int8 / np.int8 / bare int8 / "int8" / np.dtype("int8")."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _WIRE_DTYPE_NAMES else None
+    if isinstance(node, ast.Name):
+        return node.id if node.id in _WIRE_DTYPE_NAMES else None
+    chain = _attr_chain(node)
+    if chain and chain[-1] in _WIRE_DTYPE_NAMES:
+        return chain[-1]
+    if isinstance(node, ast.Call):
+        fchain = _attr_chain(node.func)
+        if fchain and fchain[-1] == "dtype" and node.args:
+            return _wire_dtype_of(node.args[0])
+    return None
+
+
+def check_wire_dtype_cast(ctx, shared):
+    if ctx.relpath.endswith(_QUANT_SANCTIONED_SUFFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args):
+            continue
+        name = _wire_dtype_of(node.args[0])
+        if name:
+            yield Finding(
+                "HVD010", ctx.relpath, node.lineno, node.col_offset,
+                f"direct wire-dtype cast '.astype({name})' outside the "
+                "codec registry: a bare narrow cast drops the per-block "
+                "scales, skips error feedback, and bypasses the "
+                "negotiated per-tensor codec plan — peers decode "
+                "garbage or the sums silently lose 2-3 decimal digits. "
+                "Encode through ops/quantization.py "
+                "(encode/wire_dtype) or a registered codec "
+                "(Compression.from_name), the two sanctioned homes for "
+                "wire-width casts.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1101,5 +1156,40 @@ and read the verdict from the monitor's records or the
 ``hvd_nonfinite_total`` counter. Tests and examples are outside the
 lint scope and may assert finiteness directly.""",
             check_adhoc_numerics),
+        Rule(
+            "HVD010", "wire-dtype-cast-bypasses-codec",
+            "direct narrow-dtype astype outside the codec registry",
+            """HVD010 — wire-dtype cast that bypasses the codec registry
+
+The quantized wire (ops/quantization.py, PR 8) is block-scaled: every
+narrow payload travels WITH its per-block f32 max-abs scales, the
+reduction dequantizes to f32 before summing, and an error-feedback
+residual carries the rounding to the next step. All of that lives
+behind two sanctioned modules — ops/quantization.py (the kernels) and
+ops/compression.py (the codec registry the negotiated plan and the
+``compression=`` API select from).
+
+A direct ``x.astype(jnp.int8)`` (or uint8/float8_*) anywhere else is
+an unscaled, residual-less encode: values outside [-128, 127] wrap,
+e4m3 overflows to NaN, and because the cast never consulted the
+negotiated plan, peers may decode the buffer with a different codec —
+the exact rank-asymmetric corruption the coordinator's codec
+fingerprint check exists to refuse. The historical shape: a quick
+"cast to int8 to save bandwidth" in an op or example that works on the
+author's toy tensor (range happens to fit) and corrupts real
+gradients.
+
+Flags ``.astype(d)`` where d resolves to int8/uint8/float8_e4m3fn/
+float8_e4m3/float8_e5m2 — as jnp.X/np.X attribute chains, bare
+imported names, "int8" strings, or np.dtype("int8") calls — in every
+module except the two sanctioned ones. Tests and examples are outside
+the lint scope. fp16/bf16 casts are NOT flagged: they are value-exact
+for gradients' range and legitimately appear in mixed-precision
+compute, not just on the wire.
+
+Fix: ``quantization.encode(x, block, codec)`` for wire encodes (or
+``wire_dtype(codec)`` if you genuinely need the dtype object);
+``Compression.from_name(name)`` when the codec is user-selected.""",
+            check_wire_dtype_cast),
     ]
 }
