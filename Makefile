@@ -33,6 +33,8 @@ examples:
 	    --checkpoint-dir /tmp/hvd-ci-imagenet-ckpt
 	$(CPU_MESH) $(PY) examples/transformer_lm.py --size tiny --steps 3 \
 	    --dp 2 --tp 2 --sp 2 --attention ring
+	$(CPU_MESH) $(PY) examples/serve_lm.py --requests 12 --slots 2 \
+	    --max-len 64 --baseline
 	$(CPU_MESH) $(PY) examples/synthetic_benchmark.py --model resnet18 \
 	    --batch-size 1 --image-size 32 --num-warmup-batches 1 \
 	    --num-iters 1 --num-batches-per-iter 2

@@ -574,6 +574,60 @@ def _bench_quant(hvd, on_tpu):
     return out
 
 
+def _bench_serve(on_tpu):
+    """Serving A/B gate (docs/serving.md): the SAME ServeEngine under
+    Poisson open-loop load with bimodal decode lengths, once with
+    continuous batching and once with the drain (static-batch) policy,
+    equal slot budget. Enforced (AssertionError): continuous must
+    deliver >=1.5x the decode tokens per device step — the schedule-
+    quality number, deterministic because the engine decodes all slots
+    every step so per-step device cost is occupancy-independent by
+    construction. Wall tokens/s and TTFT p50/p99 ride along as
+    reported (machine-dependent) numbers.
+
+    Both arms are warmed with a small untimed workload first: the first
+    arm otherwise pays every prefill-variant jit compile and the wall
+    numbers invert even while tokens/step tells the truth."""
+    import jax
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"))
+    from serve_lm import make_workload, serve_workload, serving_config
+    from horovod_tpu.models import transformer as tr
+
+    cfg = serving_config(on_tpu)
+    _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    slots, max_len, kv_block = 4, 64, 8
+    n_requests = 96 if on_tpu else 48
+
+    # untimed warmup: compile every prefill pad variant + the decode step
+    warm = make_workload(seed=7, n_requests=6, rate=1.0)
+    for policy in ("continuous", "drain"):
+        serve_workload(cfg, params, warm, policy, slots, max_len,
+                       kv_block=kv_block)
+
+    workload = make_workload(seed=0, n_requests=n_requests, rate=0.5)
+    cont = serve_workload(cfg, params, workload, "continuous", slots,
+                          max_len, kv_block=kv_block)
+    stat = serve_workload(cfg, params, workload, "drain", slots,
+                          max_len, kv_block=kv_block)
+    speedup = cont["tokens_per_step"] / max(stat["tokens_per_step"],
+                                            1e-9)
+    out = {
+        "requests": n_requests,
+        "slots": slots,
+        "continuous": cont,
+        "static": stat,
+        "speedup_tokens_per_step": round(speedup, 3),
+    }
+    assert cont["completed"] == stat["completed"], (
+        f"arms completed different request sets: {out}")
+    assert speedup >= 1.5, (
+        f"continuous batching {speedup:.2f}x vs static is under the "
+        f"1.5x budget: {out}")
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -749,6 +803,12 @@ def main():
     quant = None
     if os.environ.get("HVD_BENCH_QUANT", "") != "0":
         quant = _bench_quant(hvd, on_tpu)
+    # Serving A/B gate: continuous vs static batching on the same
+    # engine under Poisson load; tokens/step >=1.5x is ENFORCED, TTFT
+    # p50/p99 ride along. HVD_BENCH_SERVE=0 skips it.
+    serve = None
+    if os.environ.get("HVD_BENCH_SERVE", "") != "0":
+        serve = _bench_serve(on_tpu)
 
     image_size = 224 if on_tpu else 64
     # Largest per-chip batch that compiles+runs wins MXU utilization; fall
@@ -905,6 +965,7 @@ def main():
         "flight_recorder": flight,
         "numerics": numerics,
         "quant": quant,
+        "serve": serve,
         "metrics": metrics_snap,
     }))
     return 0

@@ -62,6 +62,15 @@ echo "--- quantization kernels (fast fail: block encode/decode, EF, codec regist
 # seconds; the multi-process codec-mismatch drill rides the full suite.
 python -m pytest tests/test_quantization.py -q -m "not slow"
 
+echo "--- serving plane (fast fail: scheduler invariants, KV ledger, SLO metrics)"
+# The serving engine (docs/serving.md) shares the model, metrics and
+# control plane with training but runs its own scheduler + KV-cache
+# accounting; a join/retire or block-ledger bug silently corrupts
+# generations, so the process-local suite (scheduler/ledger invariants,
+# admission rejection, temp-0 engine-vs-model token parity) gates here.
+# The 2-process replica-loss drill rides test_chaos_plane.py.
+python -m pytest tests/test_serving.py -q -m "not slow"
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

@@ -273,6 +273,23 @@ ENV_REGISTRY = (
      "(0 disables)."),
     ("HOROVOD_RING_ALLREDUCE", True, "0", "common/config.py",
      "Use the explicit ppermute ring allreduce backend."),
+    ("HOROVOD_SERVE_ADMISSION_TIMEOUT_S", True, "10.0",
+     "serving/queue.py",
+     "Serving admission control: reject a queued request after waiting "
+     "this long without a free slot."),
+    ("HOROVOD_SERVE_KV_BLOCK", True, "16", "serving/kv_cache.py",
+     "KV-cache allocation granularity in tokens: slots claim cache "
+     "capacity in blocks of this many positions."),
+    ("HOROVOD_SERVE_METRICS_INTERVAL_S", True, "1.0",
+     "serving/engine.py",
+     "Seconds between serving-gauge refreshes (queue depth, active "
+     "slots, KV blocks in use)."),
+    ("HOROVOD_SERVE_QUEUE_DEPTH", True, "64", "serving/queue.py",
+     "Admission-queue capacity; requests arriving at a full queue are "
+     "rejected immediately."),
+    ("HOROVOD_SERVE_SLOTS", True, "8", "serving/engine.py",
+     "Device batch slots of the continuous-batching engine (the max "
+     "concurrently decoding requests)."),
     ("HOROVOD_STALL_CHECK_DISABLE", True, "0", "common/config.py",
      "Disable the coordinator's stalled-rank warnings."),
     ("HOROVOD_STALL_CHECK_TIME_SECONDS", True, "60.0",
@@ -364,6 +381,9 @@ ENV_REGISTRY = (
     ("HVD_BENCH_QUANT", False, None, "bench.py",
      "Set 0 to skip the quantized-wire bench leg (int8 vs bf16 wire "
      "bytes + none-codec overhead gate)."),
+    ("HVD_BENCH_SERVE", False, None, "bench.py",
+     "Set 0 to skip the serving bench leg (continuous vs static "
+     "batching under Poisson load, p50/p99 TTFT)."),
     ("HVD_TEST_WORKERS", False, "auto", "ci/run_tests.sh",
      "pytest-xdist worker count for the CI suite."),
 )

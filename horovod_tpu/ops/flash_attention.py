@@ -800,3 +800,48 @@ def _vjp_bwd(causal, block_q, block_k, interpret, scale, block_q_dkv,
 
 
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def decode_attention(q, k, v, lengths, scale=None):
+    """Single-query attention against a cached K/V prefix — the decode
+    step of the serving plane (docs/serving.md).
+
+    q         [batch, 1, heads, head_dim]  — the current token's query
+    k, v      [batch, s_max, heads, head_dim] — the KV cache; only the
+              first ``lengths[b]`` positions of row b are real, the rest
+              is whatever the allocator left there (masked out here)
+    lengths   [batch] int32 — valid prefix length per row
+    scale     optional softmax scale (default head_dim ** -0.5, matching
+              flash_attention)
+
+    Deliberately plain XLA rather than a Pallas kernel: with q_len == 1
+    the QK^T product is a [s_max, d] GEMV per (batch, head) — there is no
+    [s, s] logits matrix to avoid materializing and no q-tiling to do, so
+    the flash streaming structure buys nothing. The op is HBM-bandwidth
+    bound on reading K/V once, which XLA's fused masked-softmax-GEMV
+    already achieves, and keeping it jnp makes the masked fixed-s_max
+    shape trivially jit-stable across decode steps (no recompiles as
+    rows join/retire — lengths is data, not shape).
+
+    Numerics contract (tests/test_flash_attention.py): matches the last
+    row of flash_attention / parallel.ring.full_attention over the same
+    prefix — fp32 softmax, matmuls in the input dtype with fp32
+    accumulation, output cast back to q.dtype.
+    """
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(f"decode_attention wants q [b, 1, h, d], got "
+                         f"{q.shape}")
+    b, _, h, d = q.shape
+    s_max = k.shape[1]
+    scale = d ** -0.5 if scale is None else scale
+    # [b, h, d] x [b, s, h, d] -> [b, h, s] logits, fp32 accumulation
+    logits = jnp.einsum("bhd,bshd->bhs", q[:, 0], k,
+                        preferred_element_type=jnp.float32)
+    logits = logits.astype(jnp.float32) * scale
+    pos = jnp.arange(s_max, dtype=jnp.int32)[None, None, :]
+    valid = pos < lengths.astype(jnp.int32)[:, None, None]
+    logits = jnp.where(valid, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)[:, None]

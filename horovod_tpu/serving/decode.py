@@ -1,0 +1,141 @@
+"""Prefill and single-token decode forwards over a training checkpoint.
+
+models/transformer.py defines the LM as flax modules; serving needs two
+extra entry points the training forward doesn't expose: a prefill that
+RETURNS the per-layer K/V it computed (to seed the cache), and a
+one-token decode that reads/extends that cache. Rather than threading
+cache plumbing through the training model (risking its numerics and
+sharding annotations), this module re-runs the SAME flax primitives —
+nn.Dense / nn.RMSNorm / nn.Embed with identical dtype policy, the
+model's own ``_rope`` — applied directly to the checkpoint's param
+leaves. The param tree layout (embed / layer_i.{ln_attn,attn,ln_mlp,
+mlp} / ln_f / lm_head) is the numerics contract;
+tests/test_flash_attention.py and tests/test_serving.py pin it by
+asserting logits equality and token-for-token greedy agreement against
+``TransformerLM.apply``.
+
+Attention: prefill uses the model's own dispatch (flash kernel on TPU,
+exact full attention on CPU); decode uses ops/flash_attention.py's
+``decode_attention`` (q_len=1 against the cache, fixed s_max masked by
+per-row lengths — jit-stable as rows join/retire).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..models.transformer import _dispatch_attention, _rope
+from ..ops.flash_attention import decode_attention
+
+
+def _dense(x, kernel, dtype):
+    return nn.Dense(kernel.shape[-1], use_bias=False,
+                    dtype=dtype).apply({"params": {"kernel": kernel}}, x)
+
+
+def _rmsnorm(x, scale, dtype):
+    return nn.RMSNorm(dtype=dtype).apply({"params": {"scale": scale}}, x)
+
+
+def _embed(cfg, params, tokens):
+    return nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype).apply(
+        {"params": {"embedding": params["embed"]["embedding"]}}, tokens)
+
+
+def _logits(cfg, params, x):
+    # same head math as TransformerLM: logits straight from the MXU
+    # accumulator in acc precision, tied or separate kernel
+    acc = jnp.float32 if cfg.logits_fp32 else cfg.dtype
+    if cfg.tie_embeddings:
+        kernel = params["embed"]["embedding"].T
+    else:
+        kernel = params["lm_head"]["kernel"]
+    return jnp.dot(x.astype(cfg.dtype), kernel.astype(cfg.dtype),
+                   preferred_element_type=acc)
+
+
+def _check_dense(cfg):
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "serving supports dense configs only (num_experts=0); the "
+            "MoE expert dispatch has no cached decode path yet")
+
+
+def _qkv(cfg, layer, y, positions):
+    head_dim = cfg.d_model // cfg.num_heads
+    qkv = _dense(y, layer["attn"]["qkv"]["kernel"], cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(t.shape[:-1] + (cfg.num_heads, head_dim))
+    q, k, v = map(heads, (q, k, v))
+    return _rope(q, positions), _rope(k, positions), v
+
+
+def _mlp(cfg, layer, y):
+    gate = _dense(y, layer["mlp"]["gate"]["kernel"], cfg.dtype)
+    up = _dense(y, layer["mlp"]["up"]["kernel"], cfg.dtype)
+    return _dense(nn.silu(gate) * up, layer["mlp"]["down"]["kernel"],
+                  cfg.dtype)
+
+
+def prefill_forward(cfg, params, tokens):
+    """Full causal forward over ``tokens`` [b, s], also returning the
+    rotated per-layer K/V to seed the cache.
+
+    Returns (logits [b, s, vocab], k [layers, b, s, h, d], v like k).
+    Right-padded prompts are safe: causal masking makes every real
+    position's output independent of later pad positions, and the
+    engine only copies the real prefix into the cache.
+    """
+    _check_dense(cfg)
+    b, s = tokens.shape
+    positions = jnp.arange(s)[None, :]
+    x = _embed(cfg, params, tokens)
+    ks, vs = [], []
+    for i in range(cfg.num_layers):
+        layer = params[f"layer_{i}"]
+        y = _rmsnorm(x, layer["ln_attn"]["scale"], cfg.dtype)
+        q, k, v = _qkv(cfg, layer, y, positions)
+        ks.append(k)
+        vs.append(v)
+        attn = _dispatch_attention(cfg, q, k, v, None)
+        attn = attn.reshape(b, s, cfg.d_model)
+        x = x + _dense(attn, layer["attn"]["out"]["kernel"], cfg.dtype)
+        y = _rmsnorm(x, layer["ln_mlp"]["scale"], cfg.dtype)
+        x = x + _mlp(cfg, layer, y)
+    x = _rmsnorm(x, params["ln_f"]["scale"], cfg.dtype)
+    return _logits(cfg, params, x), jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_step(cfg, params, tokens, positions, kv_k, kv_v):
+    """One decode token for every cache row at a static shape.
+
+    tokens     [b] int32 — the token each row feeds in this step
+    positions  [b] int32 — where that token sits (== tokens already in
+               the row's cache; its K/V are written there)
+    kv_k/kv_v  [layers, b, s_max, h, d] — the dense cache; rows beyond
+               a row's length hold junk that the length mask hides, so
+               inactive slots may receive garbage writes harmlessly
+
+    Returns (logits [b, vocab], kv_k, kv_v) with the new token's K/V
+    appended at ``positions``; attention spans 0..positions inclusive.
+    """
+    _check_dense(cfg)
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
+    pos2 = positions[:, None]  # [b, 1] per-row positions for rope
+    x = _embed(cfg, params, tokens[:, None])
+    lengths = positions + 1
+    for i in range(cfg.num_layers):
+        layer = params[f"layer_{i}"]
+        y = _rmsnorm(x, layer["ln_attn"]["scale"], cfg.dtype)
+        q, k, v = _qkv(cfg, layer, y, pos2)
+        kv_k = kv_k.at[i, rows, positions].set(k[:, 0])
+        kv_v = kv_v.at[i, rows, positions].set(v[:, 0])
+        attn = decode_attention(q, kv_k[i], kv_v[i], lengths)
+        attn = attn.reshape(b, 1, cfg.d_model)
+        x = x + _dense(attn, layer["attn"]["out"]["kernel"], cfg.dtype)
+        y = _rmsnorm(x, layer["ln_mlp"]["scale"], cfg.dtype)
+        x = x + _mlp(cfg, layer, y)
+    x = _rmsnorm(x, params["ln_f"]["scale"], cfg.dtype)
+    return _logits(cfg, params, x)[:, 0], kv_k, kv_v

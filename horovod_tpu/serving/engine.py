@@ -1,0 +1,312 @@
+"""The continuous-batching step loop (docs/serving.md).
+
+One ``step()`` = admit joins, one fused decode over every batch slot,
+retire finishers. The device work is shape-static by construction:
+
+  * decode always runs all ``num_slots`` rows — inactive rows compute
+    garbage that the host ignores and write garbage K/V into their own
+    (inactive) cache rows, which the next prefill overwrites. Occupancy
+    is data, not shape, so join/retire never recompiles.
+  * prefill pads each prompt to a KV-block multiple, bounding compile
+    variants at max_len / block; causal masking makes the pads inert.
+  * exactly ONE host readback per decode step (the sampled token ids)
+    and one per prefill (the first token) — the contract hvdlint HVD011
+    enforces over this package; both sites carry the sanctioned
+    disable marker.
+
+The drain policy turns the same engine into the static-batch baseline
+(admit only into an idle batch, run the wave to completion) that
+bench.py's HVD_BENCH_SERVE leg compares against — one code path, one
+flag, no drift between the system and its baseline.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import config
+from ..common.exceptions import RanksLostError
+from ..utils import metrics as hvd_metrics
+from ..utils import tracing as hvd_tracing
+from .decode import decode_step, prefill_forward
+from .kv_cache import KVCache
+from .queue import AdmissionQueue, RequestResult
+from .sampling import sample_tokens
+from .scheduler import SlotScheduler
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill_jit(cfg, params, tokens, last_index, temperature, rng):
+    """Prefill + first-token sample; returns (token, k, v) with k/v
+    [layers, 1, s_pad, h, d]."""
+    logits, k, v = prefill_forward(cfg, params, tokens)
+    row = logits[0, last_index][None]  # [1, vocab]
+    tok = sample_tokens(rng, row, temperature[None])[0]
+    return tok, k, v
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _decode_jit(cfg, params, tokens, positions, kv_k, kv_v, temps, rng):
+    logits, kv_k, kv_v = decode_step(cfg, params, tokens, positions,
+                                     kv_k, kv_v)
+    return sample_tokens(rng, logits, temps), kv_k, kv_v
+
+
+@jax.jit
+def _write_slot(kv_k, kv_v, pk, pv, slot):
+    """Copy a prefill's K/V into cache row ``slot`` (dynamic index,
+    static prefix length from pk's shape)."""
+    s_pad = pk.shape[2]
+    kv_k = kv_k.at[:, slot, :s_pad].set(pk[:, 0])
+    kv_v = kv_v.at[:, slot, :s_pad].set(pv[:, 0])
+    return kv_k, kv_v
+
+
+class _Active:
+    """Host-side per-slot decode state."""
+
+    __slots__ = ("request", "generated", "next_token", "next_pos",
+                 "last_token_ts", "ttft_s")
+
+    def __init__(self, request, first_token, prompt_len, now):
+        self.request = request
+        self.generated = [first_token]
+        self.next_token = first_token  # fed to the next decode step
+        self.next_pos = prompt_len  # cache position it will occupy
+        self.last_token_ts = now
+        self.ttft_s = now - request.arrival_ts
+
+
+class ServeEngine:
+    """Continuous-batching engine over one model replica.
+
+    ``policy="drain"`` is the static-batch baseline; everything else
+    about the engine (kernels, cache, sampling, metrics) is identical.
+    ``replica`` (serving.replica.ReplicaGroup) plugs the engine into
+    the control plane's liveness ledger: each step heartbeats, and a
+    declared-lost peer triggers the failover callback + a flight dump
+    instead of a hang.
+    """
+
+    def __init__(self, cfg, params, num_slots=None, max_len=None,
+                 kv_block=None, total_blocks=None, policy="continuous",
+                 queue=None, seed=0, replica=None, on_ranks_lost=None,
+                 clock=time.monotonic):
+        self.cfg = cfg
+        self.params = params
+        num_slots = (config.env_int("SERVE_SLOTS", 8)
+                     if num_slots is None else num_slots)
+        self.kv = KVCache(cfg, num_slots, max_len=max_len,
+                          block_size=kv_block, total_blocks=total_blocks)
+        self.scheduler = SlotScheduler(num_slots, policy=policy)
+        self.queue = queue if queue is not None else AdmissionQueue()
+        self._clock = clock
+        self._rng = jax.random.PRNGKey(seed)
+        self._step_count = 0
+        self._replica = replica
+        self._on_ranks_lost = on_ranks_lost
+        self._active = {}  # slot -> _Active
+        self._finished = []
+        reg = self._metrics = hvd_metrics.get_registry()
+        self._m_requests = reg.counter(
+            "hvd_serve_requests_total",
+            "Serving requests by terminal outcome "
+            "(completed/rejected/failed).", labels=("outcome",))
+        self._m_tokens = reg.counter(
+            "hvd_serve_tokens_total",
+            "Tokens processed by the serving engine, by phase.",
+            labels=("phase",))
+        self._m_ttft = reg.histogram(
+            "hvd_serve_ttft_seconds",
+            "Time to first token: request arrival to the prefill "
+            "sample.")
+        self._m_intertoken = reg.histogram(
+            "hvd_serve_intertoken_seconds",
+            "Gap between consecutive decode tokens of one request.")
+        self._m_active = reg.gauge(
+            "hvd_serve_active_slots",
+            "Batch slots currently decoding a request.")
+        self._m_blocks = reg.gauge(
+            "hvd_serve_kv_blocks_in_use",
+            "KV-cache blocks currently claimed by active slots.")
+        self._gauge_interval = config.env_float(
+            "SERVE_METRICS_INTERVAL_S", 1.0)
+        self._last_gauge_ts = -1e30
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, request):
+        return self.queue.submit(request)
+
+    # -- the step loop --------------------------------------------------
+
+    def step(self):
+        """One scheduler iteration. Returns the requests that finished
+        during it (as RequestResults, also kept on self.results)."""
+        self._heartbeat()
+        dirty = self._admit()
+        self.scheduler.begin_wave()
+        dirty |= self._decode()
+        self._refresh_gauges(force=dirty)
+        done, self._finished = self._finished, []
+        return done
+
+    def run_to_completion(self, max_steps=100000):
+        """Drive step() until queue and batch are empty; the engine's
+        synchronous-driver mode (examples/serve_lm.py, the tests)."""
+        out = []
+        for _ in range(max_steps):
+            out.extend(self.step())
+            if not self._active and not len(self.queue):
+                break
+        return out
+
+    @property
+    def active_count(self):
+        return len(self._active)
+
+    # -- internals ------------------------------------------------------
+
+    def _heartbeat(self):
+        if self._replica is None:
+            return
+        try:
+            self._replica.heartbeat()
+        except RanksLostError as err:
+            lost = tuple(int(r) for r in err.ranks)
+            self._metrics.event("serve_failover", lost_ranks=list(lost))
+            hvd_tracing.get_tracer().dump("serve_ranks_lost")
+            replica, self._replica = self._replica, None
+            replica.close()
+            if self._on_ranks_lost is not None:
+                self._on_ranks_lost(lost)
+
+    def _pad_len(self, n):
+        block = self.kv.ledger.block_size
+        return min(-(-n // block) * block, self.kv.max_len)
+
+    def _admit(self):
+        admitted = False
+        while self.scheduler.can_join():
+            req = self.queue.pop()
+            if req is None:
+                break
+            prompt_len = len(req.prompt)
+            # cache rows needed over the request's whole life: the final
+            # generated token is sampled but never written back
+            final_len = prompt_len + max(req.max_new_tokens - 1, 0)
+            if (prompt_len == 0 or final_len > self.kv.max_len or
+                    self.kv.ledger._blocks_for(final_len) >
+                    self.kv.ledger.total_blocks):
+                self._m_requests.labels(outcome="failed").inc()
+                self._metrics.event(
+                    "serve_reject", request_id=req.request_id,
+                    reason="too_long")
+                self._finished.append(RequestResult(
+                    req.request_id, (), "failed", reason="too_long",
+                    finish_ts=self._clock()))
+                continue
+            if not self.kv.ledger.can_alloc(final_len):
+                # cache pressure, not impossibility: wait for retirements.
+                # Gate on the WHOLE-life need, not just the prompt — an
+                # optimistic admit would decode for a while and then die
+                # kv_exhausted when a later joiner took the headroom.
+                self.queue.requeue(req)
+                break
+            self._prefill(req, prompt_len, final_len)
+            admitted = True
+        return admitted
+
+    def _prefill(self, req, prompt_len, final_len):
+        slot = self.scheduler.join(req.request_id)
+        self.kv.ledger.alloc_at(slot, prompt_len, reserve=final_len)
+        s_pad = self._pad_len(prompt_len)
+        tokens = np.zeros((1, s_pad), np.int32)
+        tokens[0, :prompt_len] = req.prompt
+        rng = jax.random.fold_in(self._rng, self._step_count)
+        self._step_count += 1
+        tok, pk, pv = _prefill_jit(
+            self.cfg, self.params, jnp.asarray(tokens),
+            jnp.int32(prompt_len - 1), jnp.float32(req.temperature), rng)
+        self.kv.k, self.kv.v = _write_slot(self.kv.k, self.kv.v, pk, pv,
+                                           jnp.int32(slot))
+        # the one sanctioned per-prefill readback: the first token
+        # hvdlint: disable=HVD011(first-token sample is the prefill's output)
+        first = int(jax.device_get(tok))
+        now = self._clock()
+        self._active[slot] = _Active(req, first, prompt_len, now)
+        self._m_tokens.labels(phase="prefill").inc(prompt_len)
+        self._m_tokens.labels(phase="decode").inc()
+        self._m_ttft.observe(self._active[slot].ttft_s)
+        self._metrics.event("serve_admit", request_id=req.request_id,
+                            slot=slot, prompt_len=prompt_len,
+                            ttft_s=round(self._active[slot].ttft_s, 6))
+        if req.max_new_tokens <= 1:
+            self._retire(slot, "completed")
+
+    def _decode(self):
+        if not self._active:
+            return False
+        S = self.kv.num_slots
+        tokens = np.zeros(S, np.int32)
+        positions = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        for slot, st in self._active.items():
+            tokens[slot] = st.next_token
+            positions[slot] = st.next_pos
+            temps[slot] = st.request.temperature
+        rng = jax.random.fold_in(self._rng, self._step_count)
+        self._step_count += 1
+        nxt, self.kv.k, self.kv.v = _decode_jit(
+            self.cfg, self.params, jnp.asarray(tokens),
+            jnp.asarray(positions), self.kv.k, self.kv.v,
+            jnp.asarray(temps), rng)
+        # the one sanctioned per-step readback: this step's sampled ids
+        # hvdlint: disable=HVD011(the per-step batched token readback)
+        sampled = np.asarray(jax.device_get(nxt))
+        now = self._clock()
+        for slot in list(self._active):
+            st = self._active[slot]
+            # the fed token's K/V landed at next_pos this step
+            if not self.kv.ledger.grow(slot, st.next_pos + 1):
+                self._retire(slot, "failed", reason="kv_exhausted")
+                continue
+            tok = int(sampled[slot])
+            st.generated.append(tok)
+            st.next_token = tok
+            st.next_pos += 1
+            self._m_intertoken.observe(now - st.last_token_ts)
+            st.last_token_ts = now
+            self._m_tokens.labels(phase="decode").inc()
+            req = st.request
+            if len(st.generated) >= req.max_new_tokens:
+                self._retire(slot, "completed")
+            elif (req.deadline_s is not None and
+                    now - req.arrival_ts > req.deadline_s):
+                self._retire(slot, "failed", reason="deadline")
+        return True
+
+    def _retire(self, slot, outcome, reason=""):
+        st = self._active.pop(slot)
+        self.kv.ledger.free(slot)
+        self.scheduler.retire(slot)
+        self._m_requests.labels(outcome=outcome).inc()
+        now = self._clock()
+        self._metrics.event("serve_retire",
+                            request_id=st.request.request_id, slot=slot,
+                            outcome=outcome, reason=reason,
+                            tokens=len(st.generated))
+        self._finished.append(RequestResult(
+            st.request.request_id, tuple(st.generated), outcome,
+            ttft_s=st.ttft_s, finish_ts=now, reason=reason))
+
+    def _refresh_gauges(self, force=False):
+        now = self._clock()
+        if not force and now - self._last_gauge_ts < self._gauge_interval:
+            return
+        self._last_gauge_ts = now
+        self._m_active.set(len(self._active))
+        self._m_blocks.set(self.kv.ledger.blocks_in_use)

@@ -1,0 +1,132 @@
+"""Admission control for the serving plane.
+
+A bounded request queue in front of the engine: requests carry their SLO
+tags (deadline, temperature, token budget) and are REJECTED loudly —
+counter + event — when the queue is full or their admission deadline
+passes while they wait. Silent unbounded queuing is the classic way a
+serving system turns one overload spike into minutes of blown SLOs;
+bounding depth and ejecting stale work keeps the tail honest
+(docs/serving.md).
+
+Host-side only: no jax imports, so admission logic is testable without
+a device mesh.
+"""
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..common import config
+from ..utils import metrics as hvd_metrics
+
+
+@dataclass
+class Request:
+    """One generation request with its SLO tags.
+
+    ``deadline_s`` is a relative budget from arrival: a request still
+    queued past it is rejected (reason=deadline) instead of occupying a
+    slot it can no longer use. None means the queue-wide admission
+    timeout (HVD_SERVE_ADMISSION_TIMEOUT_S) applies alone.
+    """
+    request_id: str
+    prompt: tuple  # token ids
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    deadline_s: Optional[float] = None
+    arrival_ts: float = field(default=0.0)
+
+
+@dataclass
+class RequestResult:
+    """What the engine hands back per request (docs/serving.md)."""
+    request_id: str
+    tokens: tuple  # generated token ids (prompt excluded)
+    outcome: str  # completed | failed
+    ttft_s: Optional[float] = None  # arrival -> first token
+    finish_ts: float = 0.0
+    reason: str = ""  # detail for outcome=failed
+
+
+class AdmissionQueue:
+    """Bounded FIFO with deadline-aware pop.
+
+    submit() returns False (and counts/evts the rejection) at a full
+    queue — callers see backpressure immediately instead of queuing into
+    a blown deadline. pop() skips requests whose admission window
+    expired while queued, rejecting those too.
+    """
+
+    def __init__(self, max_depth=None, admission_timeout_s=None,
+                 clock=time.monotonic):
+        self.max_depth = (config.env_int("SERVE_QUEUE_DEPTH", 64)
+                          if max_depth is None else max_depth)
+        self.admission_timeout_s = (
+            config.env_float("SERVE_ADMISSION_TIMEOUT_S", 10.0)
+            if admission_timeout_s is None else admission_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._q = deque()
+        reg = hvd_metrics.get_registry()
+        self._m_requests = reg.counter(
+            "hvd_serve_requests_total",
+            "Serving requests by terminal outcome "
+            "(completed/rejected/failed).", labels=("outcome",))
+        self._m_depth = reg.gauge(
+            "hvd_serve_queue_depth",
+            "Requests waiting for a batch slot right now.")
+        self._metrics = reg
+
+    def __len__(self):
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, request):
+        """Admit or reject; returns whether the request was queued."""
+        now = self._clock()
+        if not request.arrival_ts:
+            request.arrival_ts = now
+        with self._lock:
+            if len(self._q) >= self.max_depth:
+                self._reject(request, "queue_full")
+                return False
+            self._q.append(request)
+            self._m_depth.set(len(self._q))
+        return True
+
+    def pop(self):
+        """Next admissible request, or None. Requests whose admission
+        window (own deadline_s, else the queue timeout) expired while
+        waiting are rejected here, not handed to the engine."""
+        now = self._clock()
+        while True:
+            with self._lock:
+                if not self._q:
+                    self._m_depth.set(0)
+                    return None
+                req = self._q.popleft()
+                self._m_depth.set(len(self._q))
+            budget = (req.deadline_s if req.deadline_s is not None
+                      else self.admission_timeout_s)
+            if now - req.arrival_ts > budget:
+                self._reject(req, "deadline")
+                continue
+            return req
+
+    def requeue(self, request):
+        """Put an already-admitted request back at the head — the
+        engine's cache-pressure path (no free KV blocks yet). Not a new
+        admission: depth may transiently exceed max_depth rather than
+        dropping work the queue accepted."""
+        with self._lock:
+            self._q.appendleft(request)
+            self._m_depth.set(len(self._q))
+
+    def _reject(self, request, reason):
+        self._m_requests.labels(outcome="rejected").inc()
+        self._metrics.event("serve_reject", request_id=request.request_id,
+                            reason=reason,
+                            waited_s=self._clock() - request.arrival_ts
+                            if request.arrival_ts else 0.0)

@@ -1,0 +1,33 @@
+"""Token sampling for the decode loop — jit-safe, per-row policy.
+
+One function covering both policies the engine offers: temperature 0 is
+exact argmax (the reproducibility contract — KV-cached greedy decoding
+must match the no-cache forward token-for-token,
+tests/test_serving.py), any positive temperature is softmax sampling at
+that temperature. The policy is PER ROW (each batch slot carries its
+request's own temperature), selected with jnp.where rather than python
+branching so a mixed batch stays one compiled program.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(rng, logits, temperature):
+    """Next token per row.
+
+    logits       [batch, vocab] (any float dtype; upcast to fp32)
+    temperature  [batch] fp32; <= 0 selects greedy argmax for that row
+    rng          PRNGKey consumed whole (fold per step upstream)
+
+    Both candidates are computed and where()-mixed — the categorical
+    draw on greedy rows is wasted work, but vocab-sized and trivially
+    cheap next to the forward pass, and it keeps the step free of
+    data-dependent control flow (jit-clean, the repo-wide model rule).
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    drawn = jax.random.categorical(rng, logits / safe_t,
+                                   axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, drawn)

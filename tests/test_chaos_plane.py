@@ -755,3 +755,112 @@ class TestElasticAutoShrink:
         finally:
             sup.shutdown()
         assert sup.restarts == 0
+
+
+@pytest.mark.chaos
+class TestDrillServingReplicaLost:
+    def test_replica_loss_is_bounded_and_postmortem_names_the_rank(
+            self, tmp_path):
+        """Drill (f), the serving plane: 2 replica processes on the
+        negotiation control plane. Replica 1 wedges mid-stream (stops
+        heartbeating but stays alive — the nasty case: no TCP reset, no
+        exit code). Replica 0's engine must turn that silence into a
+        bounded-time failover — RanksLostError via its per-step
+        heartbeat, a serve_failover event, a flight dump — and KEEP
+        SERVING: requests submitted after the failover still complete.
+        Then THIS process runs hvd_postmortem over the dumps and the
+        verdict must name the lost replica."""
+
+        def fn():
+            import os
+            import time
+            import jax
+            import jax.numpy as jnp
+            from horovod_tpu.models import transformer as tr
+            from horovod_tpu.serving.engine import ServeEngine
+            from horovod_tpu.serving.queue import AdmissionQueue, Request
+            from horovod_tpu.serving.replica import ReplicaGroup
+            from horovod_tpu.utils import tracing as hvd_tracing
+
+            r = int(os.environ["HVD_PROCESS_ID"])
+            port = int(os.environ["DRILL_PORT"])
+            done_file = os.environ["DRILL_DONE_FILE"]
+            hvd_tracing.reset(enabled=True, rank=r)
+            group = ReplicaGroup(r, 2, ("127.0.0.1", port), key=b"k" * 32,
+                                 rank_lost_timeout_s=1.5,
+                                 start_timeout_s=120.0)
+            if r == 1:
+                # the victim: a few healthy heartbeats, then silence
+                for _ in range(3):
+                    group.heartbeat()
+                    time.sleep(0.05)
+                deadline = time.monotonic() + 120.0
+                while not os.path.exists(done_file) and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.1)
+                group.close(linger_s=0.0)
+                return (r, None, None, None)
+
+            # replica 0: a real serving engine riding the group
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            lost_box = []
+            queue = AdmissionQueue(max_depth=32, admission_timeout_s=1e9)
+            engine = ServeEngine(
+                cfg, params, num_slots=2, max_len=32, kv_block=8,
+                queue=queue, replica=group,
+                on_ranks_lost=lost_box.append)
+            for i in range(2):
+                engine.submit(Request(f"pre-{i}", (3, 1, 4),
+                                      max_new_tokens=24))
+            results = []
+            t0 = time.monotonic()
+            detect_s = None
+            while time.monotonic() - t0 < 60.0:
+                results.extend(engine.step())
+                if lost_box:
+                    detect_s = time.monotonic() - t0
+                    break
+            # release the victim before any assertion can exit early
+            with open(done_file, "w") as f:
+                f.write("done")
+            # failover must not stop the music: post-loss requests serve
+            for i in range(2):
+                engine.submit(Request(f"post-{i}", (1, 2),
+                                      max_new_tokens=4))
+            results.extend(engine.run_to_completion())
+            completed = sorted(x.request_id for x in results
+                               if x.outcome == "completed")
+            return (r, detect_s, lost_box, completed)
+
+        env = dict(_ENV)
+        env["HVD_FLIGHT_DIR"] = str(tmp_path)
+        env["DRILL_PORT"] = str(network.free_port())
+        env["DRILL_DONE_FILE"] = str(tmp_path / "victim.done")
+        results = run(fn, num_proc=2, env=env, start_timeout_s=180.0)
+
+        by_rank = {x[0]: x for x in results}
+        _, detect_s, lost_box, completed = by_rank[0]
+        assert detect_s is not None, \
+            "replica 0 never detected the wedged peer (the silent hang)"
+        assert detect_s < 30.0, f"detection took {detect_s:.1f}s"
+        assert lost_box == [(1,)], lost_box
+        # serving continued through the failover: every request —
+        # submitted before AND after the loss — completed
+        assert completed == ["post-0", "post-1", "pre-0", "pre-1"]
+
+        # the drill leaves real dumps behind; the postmortem must blame
+        # the lost replica from them alone
+        dumps = sorted(p.name for p in tmp_path.glob("flight-rank*.json"))
+        assert "flight-rank0.json" in dumps, dumps
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_postmortem
+        loaded, bad = hvd_postmortem.load_dumps(
+            hvd_postmortem.find_dumps(str(tmp_path)))
+        assert not bad
+        hvd_postmortem.rebase(loaded)
+        verdict = hvd_postmortem.analyze(loaded)
+        assert verdict["divergent_rank"] == 1, verdict

@@ -250,3 +250,101 @@ class TestTransformerFlash:
             params, opt_state, loss = step(params, opt_state, tokens)
             losses.append(float(loss))
         assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+class TestDecodeAttention:
+    """Single-query decode path (serving plane): numerics against the
+    reference full attention and KV-cached generation parity."""
+
+    @pytest.mark.parametrize("length", [1, 5, 24, 64])
+    def test_matches_full_attention_last_row(self, hvd, length):
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import decode_attention
+        from horovod_tpu.parallel.ring import full_attention
+        s_max = 64
+        q_all, k, v = _qkv(0, b=2, s=s_max, h=4, d=32)
+        # causal full attention over the first `length` tokens: its last
+        # row is exactly one query attending a `length`-long prefix
+        ref = full_attention(q_all[:, :length], k[:, :length],
+                             v[:, :length], causal=True)[:, -1:]
+        lengths = jnp.full((2,), length, jnp.int32)
+        out = decode_attention(q_all[:, length - 1:length], k, v, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_masks_beyond_length_per_row(self, hvd):
+        """Garbage K/V past each row's length must not leak into the
+        output — rows with different lengths, same padded cache."""
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import decode_attention
+        q, k, v = _qkv(1, b=2, s=32, h=2, d=16)
+        lengths = jnp.asarray([3, 17], jnp.int32)
+        out = decode_attention(q[:, :1], k, v, lengths)
+        # poison the tail beyond each row's length: output unchanged
+        k2 = k.at[0, 3:].set(1e4).at[1, 17:].set(-1e4)
+        v2 = v.at[0, 3:].set(1e4).at[1, 17:].set(-1e4)
+        out2 = decode_attention(q[:, :1], k2, v2, lengths)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_preserves_query_dtype(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import decode_attention
+        q, k, v = _qkv(2, b=1, s=16, h=2, d=16, dtype=jnp.bfloat16)
+        out = decode_attention(q[:, :1], k, v,
+                               jnp.asarray([9], jnp.int32))
+        assert out.dtype == jnp.bfloat16
+        assert out.shape == (1, 1, 2, 16)
+
+    def test_rejects_multi_query(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu.ops.flash_attention import decode_attention
+        q, k, v = _qkv(3, b=1, s=8, h=2, d=16)
+        with pytest.raises(ValueError):
+            decode_attention(q, k, v, jnp.asarray([8], jnp.int32))
+
+
+class TestKVCachedGeneration:
+    def test_cached_greedy_matches_no_cache_token_for_token(self, hvd):
+        """Prefill + decode_attention steps reproduce the no-cache
+        full-forward greedy continuation exactly (temp 0, fp32)."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.serving.decode import (decode_step,
+                                                prefill_forward)
+        cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                        attention_impl="full")
+        model, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = [7, 3, 11, 19, 2]
+        n_new = 12
+
+        # reference: full forward over the growing sequence every step
+        ref_toks = list(prompt)
+        ref_out = []
+        for _ in range(n_new):
+            logits = model.apply({"params": params},
+                                 jnp.asarray([ref_toks], jnp.int32))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref_out.append(nxt)
+            ref_toks.append(nxt)
+
+        # cached: one prefill, then single-token decode steps
+        max_len = 32
+        logits, pk, pv = prefill_forward(
+            cfg, params, jnp.asarray([prompt], jnp.int32))
+        kv_k = jnp.zeros((cfg.num_layers, 1, max_len, cfg.num_heads,
+                          cfg.d_model // cfg.num_heads), cfg.dtype)
+        kv_v = jnp.zeros_like(kv_k)
+        kv_k = kv_k.at[:, :, :len(prompt)].set(pk)
+        kv_v = kv_v.at[:, :, :len(prompt)].set(pv)
+        tok = int(jnp.argmax(logits[0, -1]))
+        got = [tok]
+        pos = len(prompt)
+        for _ in range(n_new - 1):
+            logits, kv_k, kv_v = decode_step(
+                cfg, params, jnp.asarray([tok], jnp.int32),
+                jnp.asarray([pos], jnp.int32), kv_k, kv_v)
+            tok = int(jnp.argmax(logits[0]))
+            got.append(tok)
+            pos += 1
+        assert got == ref_out

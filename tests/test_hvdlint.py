@@ -648,6 +648,76 @@ def test_hvd010_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD011 — blocking host sync in the serving decode loop
+# ---------------------------------------------------------------------------
+
+def test_hvd011_triggers_on_host_syncs_in_serve_loop(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_loop
+        import jax
+        import numpy as np
+
+        def decode_step_host(x):
+            tok = jax.device_get(x)
+            x.block_until_ready()
+            return np.asarray(tok)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD011"] * 3
+
+
+def test_hvd011_triggers_in_real_serving_path(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "serving"
+    mod.mkdir(parents=True)
+    f = mod / "engine.py"
+    f.write_text(textwrap.dedent("""\
+        import jax
+
+        def peek(x):
+            return jax.device_get(x)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD011"]
+
+
+def test_hvd011_jnp_asarray_and_outside_scope_are_clean(tmp_path):
+    # jnp.asarray is host->device: legal inside the loop
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_loop
+        import jax.numpy as jnp
+
+        def feed(tokens):
+            return jnp.asarray(tokens)
+        """)
+    assert live(found) == []
+    # and without the role/path scope, host syncs are someone else's
+    # business (training scripts readback all the time)
+    found = lint_source(tmp_path, """\
+        import jax
+
+        def fetch(x):
+            return jax.device_get(x)
+        """)
+    assert live(found) == []
+
+
+def test_hvd011_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_loop
+        import jax
+        import numpy as np
+
+        def sample(nxt):
+            # hvdlint: disable=HVD011(the per-step batched token readback)
+            return np.asarray(jax.device_get(nxt))
+        """)
+    assert live(found) == []
+    assert sorted(f.rule for f in found if f.suppressed == "inline") == \
+        ["HVD011", "HVD011"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -707,7 +777,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 11)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 12)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

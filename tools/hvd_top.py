@@ -329,6 +329,38 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         lines.append(f"    steps {scount:>8,}   mean {_fmt_s(ssum / scount):>8}"
                      f"   p50 {_fmt_s(sp50):>8}   tokens/s {tps:,.0f}")
 
+    # serving plane: admission, occupancy, SLO latencies
+    # (horovod_tpu/serving/; docs/serving.md)
+    sreq = _by_label(snap, "hvd_serve_requests_total", "outcome")
+    stok = _by_label(snap, "hvd_serve_tokens_total", "phase")
+    if sreq or stok:
+        lines.append(c(BOLD, "  serving"))
+        rejected = sreq.get("rejected", 0) + sreq.get("failed", 0)
+        req_line = (f"    requests      done {int(sreq.get('completed', 0)):>9,}"
+                    f"   rejected {int(sreq.get('rejected', 0)):>6,}   "
+                    f"failed {int(sreq.get('failed', 0)):>6,}   "
+                    f"queue {int(_total(snap, 'hvd_serve_queue_depth')):,}")
+        lines.append(c(YELLOW, req_line) if rejected else req_line)
+        tok_rate = _rate(snap, prev, "hvd_serve_tokens_total", dt,
+                         phase="decode")
+        lines.append(f"    tokens        prefill {_total(snap, 'hvd_serve_tokens_total', phase='prefill'):>10,.0f}"
+                     f"   decode {stok.get('decode', 0):>10,.0f}   "
+                     f"{_fmt_rate(tok_rate, ' tok/s')}")
+        lines.append(f"    occupancy     active slots "
+                     f"{int(_total(snap, 'hvd_serve_active_slots')):>4,}   "
+                     f"kv blocks "
+                     f"{int(_total(snap, 'hvd_serve_kv_blocks_in_use')):,}")
+        for label, name in (("ttft", "hvd_serve_ttft_seconds"),
+                            ("intertoken", "hvd_serve_intertoken_seconds")):
+            sh2 = _hist(snap, name)
+            if sh2 and sh2[3]:
+                bounds, counts, hsum, hcount = sh2
+                hp50 = hvd_metrics.histogram_quantile(bounds, counts, 0.5)
+                hp99 = hvd_metrics.histogram_quantile(bounds, counts, 0.99)
+                lines.append(f"    {label:<13} mean {_fmt_s(hsum / hcount):>8}"
+                             f"   p50 {_fmt_s(hp50):>8}   "
+                             f"p99 {_fmt_s(hp99):>8}")
+
     # tracing plane: per-stage span latency + the slow-span tail
     span_entry = snap.get("metrics", {}).get("hvd_span_seconds")
     slow = [e for e in snap.get("events", [])
@@ -369,8 +401,10 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         for ev in events:
             kind = ev.get("event", "?")
             code = RED if kind in ("ranks_lost", "stall_kill",
-                                   "numerics_anomaly") else (
-                YELLOW if kind in ("stall", "chaos_injection") else DIM)
+                                   "numerics_anomaly",
+                                   "serve_failover") else (
+                YELLOW if kind in ("stall", "chaos_injection",
+                                   "serve_reject") else DIM)
             detail = {k: v for k, v in ev.items()
                       if k not in ("event", "ts_us", "epoch_us")}
             lines.append(c(code, f"    [{ev.get('ts_us', 0) / 1e6:>9.3f}s] "
@@ -449,8 +483,29 @@ def canned_snapshot():
     reg.gauge("hvd_compression_norm_delta", "g",
               labels=("tensor", "compressor")).labels(
         tensor="grad/embed", compressor="fp16").set(3.1e-4)
+    sq = reg.counter("hvd_serve_requests_total", "c", labels=("outcome",))
+    sq.labels(outcome="completed").inc(1840)
+    sq.labels(outcome="rejected").inc(12)
+    sq.labels(outcome="failed").inc(3)
+    st = reg.counter("hvd_serve_tokens_total", "c", labels=("phase",))
+    st.labels(phase="prefill").inc(29_500)
+    st.labels(phase="decode").inc(61_200)
+    reg.gauge("hvd_serve_queue_depth", "g").set(7)
+    reg.gauge("hvd_serve_active_slots", "g").set(6)
+    reg.gauge("hvd_serve_kv_blocks_in_use", "g").set(22)
+    ttft = reg.histogram("hvd_serve_ttft_seconds", "h")
+    for v in (0.02, 0.03, 0.05, 0.4):
+        for _ in range(25):
+            ttft.observe(v)
+    it = reg.histogram("hvd_serve_intertoken_seconds", "h")
+    for v in (0.004, 0.006, 0.011):
+        for _ in range(200):
+            it.observe(v)
     reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",
               trace_id="r1.42", dur_ms=412.5, status="ok")
+    reg.event("serve_reject", request_id="req-9917", reason="queue_full",
+              waited_s=0.0)
+    reg.event("serve_failover", lost_ranks=[1])
     reg.event("stall", tensor="grad/dense_7", missing_ranks=[3],
               waited_s=61.2, trace_id="r1.42")
     reg.event("chaos_injection", fault="drop_response",

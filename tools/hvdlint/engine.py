@@ -25,7 +25,7 @@ _ITEM_RE = re.compile(r"(HVD\d{3})\s*(\(([^()]*)\))?")
 # fixture) declare itself subject to the module-scoped rules without
 # being on the built-in path lists in rules.py. Must be a standalone
 # comment line (anchored), so prose mentions never count.
-_ROLE_RE = re.compile(r"^\s*#\s*hvdlint:\s*role=(?P<roles>[a-z, ]+)")
+_ROLE_RE = re.compile(r"^\s*#\s*hvdlint:\s*role=(?P<roles>[a-z_, ]+)")
 
 _EXCLUDED_DIRS = {"__pycache__", "_native", ".git", ".github", "build",
                   "dist", ".claude", "node_modules"}

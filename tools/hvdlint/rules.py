@@ -943,6 +943,56 @@ def check_wire_dtype_cast(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD011 — blocking host sync in the serving decode loop
+# ---------------------------------------------------------------------------
+
+# the serving plane's decode-loop modules: code that runs once per
+# generated token. Fixture files opt in with `# hvdlint: role=serve_loop`.
+_SERVE_LOOP_SUFFIXES = (
+    "horovod_tpu/serving/engine.py",
+    "horovod_tpu/serving/decode.py",
+    "horovod_tpu/serving/sampling.py",
+    "horovod_tpu/serving/kv_cache.py",
+)
+# numpy receivers whose asarray() forces a device->host transfer when
+# handed a jax array (jnp.asarray is the opposite direction and fine)
+_HOST_NUMPY_NAMES = {"np", "numpy", "onp"}
+
+
+def check_decode_host_sync(ctx, shared):
+    if not ("serve_loop" in ctx.roles or
+            ctx.relpath.endswith(_SERVE_LOOP_SUFFIXES)):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        sync = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            sync = ".block_until_ready()"
+        else:
+            chain = _attr_chain(node.func)
+            if chain:
+                if chain[-1] == "device_get":
+                    sync = ".".join(chain) + "(...)"
+                elif chain[-1] == "asarray" and (
+                        len(chain) == 1 or chain[0] in _HOST_NUMPY_NAMES):
+                    sync = ".".join(chain) + "(...)"
+        if sync:
+            yield Finding(
+                "HVD011", ctx.relpath, node.lineno, node.col_offset,
+                f"blocking host sync '{sync}' in a serving decode-loop "
+                "module: every device_get/block_until_ready/np.asarray "
+                "on a device value stalls the decode step for a full "
+                "host round-trip, and at one call per token that is THE "
+                "classic inter-token-latency killer. The engine's "
+                "contract is exactly one sanctioned readback per decode "
+                "step (the sampled token batch) and one per prefill "
+                "(the first token) — both carry an inline disable with "
+                "a reason. Keep everything else on device.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1191,5 +1241,39 @@ Fix: ``quantization.encode(x, block, codec)`` for wire encodes (or
 ``wire_dtype(codec)`` if you genuinely need the dtype object);
 ``Compression.from_name(name)`` when the codec is user-selected.""",
             check_wire_dtype_cast),
+        Rule(
+            "HVD011", "blocking-host-sync-in-decode-loop",
+            "device_get/block_until_ready/np.asarray in a serving "
+            "decode-loop module",
+            """HVD011 — blocking host sync in the serving decode loop
+
+The serving plane (horovod_tpu/serving/, PR 9) holds inter-token
+latency to one device step per generated token by keeping the decode
+loop asynchronous: the host enqueues the next step's work while the
+device executes the current one, and the ONLY forced host<->device
+rendezvous are the engine's two sanctioned readbacks — the batched
+sampled-token ids once per decode step, and the first token once per
+prefill (both in serving/engine.py, both carrying an inline disable
+with a reason).
+
+Any other jax.device_get(...), .block_until_ready(), or
+np.asarray(device_value) on that path adds a full host round-trip per
+token. At decode cadence that is the classic inter-token-latency
+killer: the device idles while the host copies, the dispatch pipeline
+drains, and a 2x tail-latency regression ships with no functional
+symptom — generation stays correct, only slower. The historical shape:
+a debugging print or an eager shape probe left in the step loop.
+
+Scope: the decode-loop modules (serving/engine.py, decode.py,
+sampling.py, kv_cache.py) plus any file opting in with `# hvdlint:
+role=serve_loop`. Flags device_get calls (any receiver chain),
+.block_until_ready() method calls, and asarray via np/numpy or a bare
+name — jnp.asarray is host->device and stays legal.
+
+Fix: keep values on device and fold the work into the jitted step; if
+a readback is genuinely the loop's output, batch it with the
+sanctioned per-step one, or carry a disable comment stating why one
+more rendezvous per token is acceptable.""",
+            check_decode_host_sync),
     ]
 }
