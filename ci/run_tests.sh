@@ -71,6 +71,15 @@ echo "--- serving plane (fast fail: scheduler invariants, KV ledger, SLO metrics
 # The 2-process replica-loss drill rides test_chaos_plane.py.
 python -m pytest tests/test_serving.py -q -m "not slow"
 
+echo "--- checkpoint plane (fast fail: commit protocol, torture matrix, reshard)"
+# Every robustness story (elastic restart, preemption, the chaos
+# drills) stands on the checkpoint plane's one promise: anything it
+# committed restores complete and checksum-valid, or fails loud. The
+# suite is process-local and fast (the save-interruption torture matrix
+# is failpoint-driven, no subprocesses); the SIGKILL/SIGTERM restart
+# drills ride test_chaos_plane.py with the other drills.
+python -m pytest tests/test_checkpoint.py -q -m "not slow"
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

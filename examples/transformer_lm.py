@@ -34,9 +34,9 @@ import optax
 
 import horovod_tpu as hvd
 from horovod_tpu import trainer
+from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
 from horovod_tpu.models import transformer as tr
 from horovod_tpu.parallel import mesh as mesh_mod
-from horovod_tpu.utils import checkpoint
 
 
 SIZES = {"tiny": tr.TransformerConfig.tiny,
@@ -77,6 +77,11 @@ def parse_args():
                         "entries instead of materializing [B,S,V] logits "
                         "(memory-bound large-batch/long-seq configs)")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=100,
+                   help="save an async checkpoint every N steps "
+                        "(trainer.Checkpointer contract: auto-resume on "
+                        "start, SIGTERM/SIGINT exits preemption-safe "
+                        "with an emergency save and code 45)")
     p.add_argument("--eager-allreduce", action="store_true",
                    help="average gradients through the EAGER collective "
                         "core (fused stacked allreduce per step) instead "
@@ -145,13 +150,27 @@ def main():
                                         param_shardings)
         opt_state = trainer.init_opt_state(tx, params, mesh, specs)
 
+    # Checkpoint plane (docs/checkpoint.md): async saves every
+    # --checkpoint-every steps, auto-resume, preemption-safe SIGTERM
+    # exit. Only when every leaf is host-addressable — multi-host
+    # sharded params need a gather or per-process checkpointing.
+    addressable = all(getattr(x, "is_fully_addressable", True)
+                      for x in jax.tree_util.tree_leaves(
+                          (params, opt_state)))
+    ckptr = None
     start_step = 0
-    if args.checkpoint_dir and not args.eager_allreduce and \
-            checkpoint.exists(args.checkpoint_dir):
-        (params, opt_state), start_step = checkpoint.restore(
-            args.checkpoint_dir, like=(params, opt_state))
-        if verbose:
-            print(f"resumed at step {start_step}")
+    if args.checkpoint_dir and not args.eager_allreduce and not args.bench:
+        if addressable:
+            ckptr = trainer.Checkpointer(
+                args.checkpoint_dir, every=args.checkpoint_every,
+                preemption=jax.process_index() == 0,
+                rank=jax.process_index(), verbose=verbose)
+            (params, opt_state), start_step, _extra = ckptr.resume(
+                like=(params, opt_state))
+        elif verbose:
+            print("checkpointing disabled: params span non-addressable "
+                  "devices (multi-host sharded); gather or use "
+                  "per-process checkpointing")
 
     def batch_tokens():
         # [batch, seq]; the loss shifts inputs/targets internally. seq (not
@@ -177,21 +196,19 @@ def main():
         tokens_done += batch * seq
         if not args.bench and verbose and (i + 1) % 10 == 0:
             print(f"step {i + 1}: loss={float(loss):.4f}")
-        if (args.checkpoint_dir and not args.bench and verbose
-                and (i + 1) % 100 == 0):
-            if all(getattr(x, "is_fully_addressable", True)
-                   for x in jax.tree_util.tree_leaves((params, opt_state))):
-                checkpoint.save(args.checkpoint_dir, (params, opt_state),
-                                step=i + 1)
-            else:
-                print("skipping checkpoint: params span non-addressable "
-                      "devices (multi-host sharded); gather or use "
-                      "per-process checkpointing")
+        if ckptr is not None and ckptr.step_end(
+                i + 1, (params, opt_state), extra={"data_pos": i + 1}):
+            # preemption: the in-flight step finished, an emergency
+            # checkpoint committed; the elastic supervisor's
+            # --graceful-restart-on-preempt resumes from exactly here
+            sys.exit(PREEMPTED_EXIT_CODE)
     # scalar transfer, not block_until_ready: on remote-attached platforms
     # only a device→host read is a true execution barrier (same lesson as
     # bench.py's sync comments)
     float(loss)
     dt = time.perf_counter() - t0
+    if ckptr is not None:
+        ckptr.close()  # drain the async writer before reporting
 
     if verbose:
         tps = tokens_done / dt

@@ -72,6 +72,30 @@ class RanksLostError(ShutdownError):
         super(ShutdownError, self).__init__(msg)
 
 
+# Exit code a worker uses after a preemption-safe exit (SIGTERM/SIGINT
+# consumed by trainer.Checkpointer: finish the in-flight step, force an
+# emergency durable checkpoint, then exit). Distinct from generic failure
+# (1), RanksLostError fail-fast (44) and raw SIGTERM death (143): the
+# elastic supervisor keys its graceful NO-SHRINK restart on exactly this
+# code — the job is healthy, the machine is going away.
+PREEMPTED_EXIT_CODE = 45
+
+
+class CheckpointError(HorovodError):
+    """A checkpoint operation failed (commit timeout, structure
+    mismatch between the checkpoint and the ``like`` tree, background
+    writer failure). Fail-loud by design: a half-restored or silently
+    wrong train state is worse than a dead job."""
+
+
+class CorruptCheckpointError(CheckpointError):
+    """A committed checkpoint failed integrity verification on restore
+    (missing file, size drift, crc32 mismatch, incomplete leaf
+    coverage). The commit protocol guarantees interrupted saves never
+    commit, so this means real corruption — bit rot, truncation, or
+    concurrent mutation of the checkpoint directory."""
+
+
 class DuplicateNameError(HorovodError):
     """Two outstanding collectives share a name.
 

@@ -66,7 +66,7 @@ class ElasticSupervisor:
 
     def __init__(self, hosts, command, ports=DEFAULT_PORTS, verbose=1,
                  runner=None, auto_shrink_rc=None, shrink_slots=1,
-                 max_restarts=10):
+                 max_restarts=10, graceful_restart_rc=None):
         self.hosts = parse_hosts(hosts) if isinstance(hosts, str) else hosts
         self.command = list(command)
         self.starting_total = sum(h.slots for h in self.hosts)
@@ -80,6 +80,12 @@ class ElasticSupervisor:
         # bounds the kill/shrink loop so a systematically crashing job
         # cannot shrink-restart forever.
         self.auto_shrink_rc = auto_shrink_rc
+        # graceful consumption: this exit code (the preemption-safe
+        # PREEMPTED_EXIT_CODE contract — the worker finished its step,
+        # committed an emergency checkpoint and exited on purpose) means
+        # the allocation is still healthy: restart with the SAME slots,
+        # no shrink. None disables; max_restarts bounds it too.
+        self.graceful_restart_rc = graceful_restart_rc
         self.shrink_slots = shrink_slots
         self.max_restarts = max_restarts
         self.restarts = 0
@@ -143,7 +149,7 @@ class ElasticSupervisor:
             except OSError:
                 return
             try:
-                msg = int(conn.recv(1024))
+                msg = int(self._recv_message(conn))
             except (ValueError, OSError):
                 conn.close()
                 continue
@@ -157,6 +163,28 @@ class ElasticSupervisor:
                 self._exit_code = 1
                 self.shutdown()
             conn.close()
+
+    @staticmethod
+    def _recv_message(conn, max_bytes=64, timeout_s=5.0):
+        """Read the peer's whole message: loop recv until EOF. A single
+        recv() may legally return any prefix of what the peer sent
+        (TCP is a byte stream) — parsing the first chunk alone
+        truncates a slot count split across segments. Bounded both
+        ways: max_bytes caps memory, the socket timeout caps a peer
+        that connects and never closes."""
+        conn.settimeout(timeout_s)
+        chunks = []
+        total = 0
+        while True:
+            b = conn.recv(1024)
+            if not b:
+                break
+            total += len(b)
+            if total > max_bytes:
+                raise ValueError(
+                    f"elastic control message exceeds {max_bytes} bytes")
+            chunks.append(b)
+        return b"".join(chunks).strip()
 
     # -- public API --------------------------------------------------------
 
@@ -210,6 +238,18 @@ class ElasticSupervisor:
             with self._lock:
                 if proc is not self._proc:  # replaced by a restart kill
                     continue
+                if (self.graceful_restart_rc is not None and
+                        rc == self.graceful_restart_rc and
+                        self.restarts < self.max_restarts):
+                    # preemption-safe exit: the job checkpointed and
+                    # left on purpose — same allocation, no shrink
+                    if self.verbose:
+                        print(f"elastic: job exited with the preempted "
+                              f"code {rc}; restarting with the same "
+                              f"{self.current_total} slot(s)")
+                    self.restarts += 1
+                    self._start_job()
+                    continue
                 if (self.auto_shrink_rc is not None and
                         rc == self.auto_shrink_rc and
                         self.restarts < self.max_restarts):
@@ -251,6 +291,13 @@ def main(argv=None):
                    help="When the job exits with RanksLostError's exit "
                         "code (workers declared ranks dead), shrink and "
                         "restart automatically instead of exiting.")
+    p.add_argument("--graceful-restart-on-preempt", action="store_true",
+                   help="When the job exits with the preemption code "
+                        "(trainer.Checkpointer's SIGTERM contract: "
+                        "emergency checkpoint committed, exit 45), "
+                        "restart it with the SAME slots instead of "
+                        "exiting — the machine went away, the "
+                        "allocation did not.")
     p.add_argument("--shrink-slots", type=int, default=1,
                    help="Slots to drop per automatic shrink (default 1).")
     p.add_argument("--max-restarts", type=int, default=10,
@@ -260,12 +307,15 @@ def main(argv=None):
     command = args.command[1:] if args.command[:1] == ["--"] else args.command
     if not command:
         p.error("no command given")
-    from ..common.exceptions import RanksLostError
+    from ..common.exceptions import PREEMPTED_EXIT_CODE, RanksLostError
     sup = ElasticSupervisor(
         args.hosts, command,
         ports=tuple(int(x) for x in args.ports.split(",")),
         auto_shrink_rc=(RanksLostError.EXIT_CODE
                         if args.auto_shrink_on_ranks_lost else None),
+        graceful_restart_rc=(PREEMPTED_EXIT_CODE
+                             if args.graceful_restart_on_preempt
+                             else None),
         shrink_slots=args.shrink_slots,
         max_restarts=args.max_restarts).start()
     print(f"elastic: listening on port {sup.port}; send an integer to "

@@ -14,6 +14,8 @@ Two idioms, mirroring the two ways the framework exposes collectives:
 """
 
 import functools
+import signal
+import threading
 import time
 
 import jax
@@ -22,8 +24,11 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .common import compat
+from .common.config import env_bool, env_int
+from .common.exceptions import PREEMPTED_EXIT_CODE
 from . import optim
 from .ops.compression import Compression
+from .utils import checkpoint as hvd_checkpoint
 from .utils import metrics as hvd_metrics
 from .utils import tracing as hvd_tracing
 
@@ -74,6 +79,120 @@ def instrument_step(step_fn, tokens_per_step=None, name="train"):
         return out
 
     return wrapped
+
+
+class Checkpointer:
+    """The train loop's checkpoint contract: periodic async saves,
+    auto-resume, and preemption-safe exit, in three calls.
+
+    ::
+
+        ckpt = trainer.Checkpointer(args.checkpoint_dir,
+                                    every=args.checkpoint_every)
+        state, start_step, extra = ckpt.resume(like=(params, opt_state))
+        for i in range(start_step, steps):
+            ...one optimizer step...
+            if ckpt.step_end(i + 1, (params, opt_state),
+                             extra={"data_pos": i + 1}):
+                sys.exit(trainer.PREEMPTED_EXIT_CODE)
+        ckpt.close()
+
+    ``step_end`` saves every ``every`` steps through the async
+    CheckpointManager (the step loop blocks only for the host snapshot)
+    and consumes preemption: on SIGTERM/SIGINT it lets the in-flight
+    step finish, then forces an emergency BLOCKING save of the state it
+    was handed and returns True — the caller exits with
+    ``PREEMPTED_EXIT_CODE`` (45), which the elastic supervisor treats
+    as a graceful no-shrink restart. ``extra`` carries whatever resume
+    needs beyond the tree (RNG key, data position) into the manifest.
+
+    Signal handlers chain to any previously installed callable handler
+    (e.g. the tracing plane's SIGTERM flight dump) and are only
+    installed from the main thread; ``preemption=False`` or
+    HVD_CKPT_PREEMPTION=0 disables them.
+    """
+
+    def __init__(self, directory, every=None, keep=None, async_save=None,
+                 preemption=None, rank=0, world_size=1, manager=None,
+                 verbose=False):
+        self.every = env_int("CKPT_EVERY", 0) if every is None else int(every)
+        self.manager = manager or hvd_checkpoint.CheckpointManager(
+            directory, rank=rank, world_size=world_size, keep=keep,
+            async_save=async_save)
+        self.verbose = verbose
+        self._preempt = threading.Event()
+        self._signals = []
+        if preemption is None:
+            preemption = env_bool("CKPT_PREEMPTION", True)
+        if preemption:
+            self._install_handlers()
+
+    def _install_handlers(self):
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev = signal.getsignal(sig)
+
+                def handler(signum, frame, _prev=prev):
+                    self._preempt.set()
+                    hvd_metrics.get_registry().event(
+                        "ckpt_preempt", signum=int(signum))
+                    # chain CUSTOM handlers only (the tracing plane's
+                    # flight dump); SIG_DFL/SIG_IGN/the default
+                    # KeyboardInterrupt raiser would abort the
+                    # in-flight step we promised to finish
+                    if callable(_prev) and _prev not in (
+                            signal.SIG_IGN, signal.SIG_DFL,
+                            signal.default_int_handler):
+                        _prev(signum, frame)
+
+                signal.signal(sig, handler)
+                self._signals.append(sig)
+            except ValueError:
+                return  # not the main thread: run without handlers
+
+    @property
+    def preempted(self):
+        return self._preempt.is_set()
+
+    def resume(self, like=None):
+        """(state, start_step, extra) — the checkpointed state when one
+        exists, else ``(like, 0, {})``. Feed the tree through
+        ``broadcast_parameters`` on multi-rank jobs for consistency."""
+        if not self.manager.exists():
+            return like, 0, {}
+        tree, step, extra = self.manager.restore(like=like)
+        if self.verbose:
+            print(f"checkpoint: resumed step {step} from "
+                  f"{self.manager.directory}")
+        return tree, step, extra
+
+    def step_end(self, step, state, extra=None):
+        """Call after every completed optimizer step. Returns True when
+        the process should exit with PREEMPTED_EXIT_CODE (an emergency
+        durable checkpoint of ``state`` has already committed)."""
+        if self._preempt.is_set():
+            self.manager.save(state, step, extra=extra, block=True,
+                              kind="emergency")
+            hvd_metrics.get_registry().event("ckpt_emergency_exit",
+                                             step=int(step))
+            if self.verbose:
+                print(f"checkpoint: preempted — emergency save at step "
+                      f"{step} committed, exiting "
+                      f"{PREEMPTED_EXIT_CODE}")
+            self.close()
+            return True
+        if self.every and step % self.every == 0:
+            self.manager.save(state, step, extra=extra)
+        return False
+
+    def close(self):
+        for sig in self._signals:
+            try:
+                signal.signal(sig, signal.SIG_DFL)
+            except ValueError:
+                pass
+        self._signals = []
+        self.manager.close()
 
 
 def softmax_cross_entropy(logits, labels, weights=None):

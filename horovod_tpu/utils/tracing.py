@@ -450,11 +450,14 @@ def install_signal_dump():
         prev = _sigterm_prev
         if callable(prev):
             prev(signum, frame)
-        else:
-            # restore the default disposition and re-deliver so the
-            # process still dies with the conventional 143 status
+        elif signal.getsignal(signum) is _handler:
+            # outermost owner of the signal: restore the default
+            # disposition and re-deliver so the process still dies with
+            # the conventional 143 status
             signal.signal(signum, signal.SIG_DFL)
             os.kill(os.getpid(), signum)
+        # else a later-installed handler (the Checkpointer's preemption
+        # flag) wrapped this one and owns process fate — dump only
 
     try:
         _sigterm_prev = signal.getsignal(signal.SIGTERM)

@@ -17,6 +17,7 @@ import subprocess
 import sys
 import time
 
+import numpy as np
 import pytest
 
 import horovod_tpu
@@ -864,3 +865,199 @@ class TestDrillServingReplicaLost:
         hvd_postmortem.rebase(loaded)
         verdict = hvd_postmortem.analyze(loaded)
         assert verdict["divergent_rank"] == 1, verdict
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-plane drills: a real trainer subprocess under a real
+# ElasticSupervisor, killed for real. Deterministic numpy "training"
+# (per-step seeded data, loss depends on the whole weight history) so a
+# wrong resume shows up as a diverged loss trajectory, not a vibe.
+# ---------------------------------------------------------------------------
+
+_DRILL_TRAINER = """\
+import os, sys, time
+
+import numpy as np
+
+from horovod_tpu import trainer
+from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+
+ck = trainer.Checkpointer(os.environ["DRILL_CKPT"],
+                          every=int(os.environ["DRILL_EVERY"]),
+                          async_save=False)
+state, start, extra = ck.resume(like={"w": np.zeros(4)})
+w = np.asarray(state["w"], dtype=np.float64)
+steps = int(os.environ["DRILL_STEPS"])
+f = open(os.environ["DRILL_PROG"], "a")
+for i in range(start, steps):
+    rng = np.random.default_rng(i)  # data position == step: resumable
+    g = rng.standard_normal(4)
+    w = w - 0.5 * (w - g)
+    loss = float(np.sum((w - g) ** 2))
+    f.write(f"{i + 1} {loss!r}\\n")
+    f.flush()
+    os.fsync(f.fileno())
+    time.sleep(float(os.environ["DRILL_SLEEP"]))
+    if ck.step_end(i + 1, {"w": w}, extra={"data_pos": i + 1}):
+        sys.exit(PREEMPTED_EXIT_CODE)
+ck.close()
+"""
+
+
+def _drill_trajectory(steps):
+    """The uninterrupted run's exact (step, loss) sequence, computed
+    in-process with the same arithmetic the drill trainer executes."""
+    w = np.zeros(4, dtype=np.float64)
+    out = []
+    for i in range(steps):
+        rng = np.random.default_rng(i)
+        g = rng.standard_normal(4)
+        w = w - 0.5 * (w - g)
+        out.append((i + 1, float(np.sum((w - g) ** 2))))
+    return out
+
+
+def _progress_lines(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    for line in open(path).read().splitlines():
+        parts = line.split()
+        if len(parts) == 2:  # a kill can tear the final line mid-write
+            try:
+                out.append((int(parts[0]), float(parts[1])))
+            except ValueError:
+                pass
+    return out
+
+
+class _CapturingRunner:
+    """ElasticSupervisor runner that launches the real subprocess and
+    remembers it so the drill can deliver signals to the CURRENT job."""
+
+    def __init__(self, env):
+        self.env = env
+        self.procs = []
+
+    def __call__(self, argv):
+        p = subprocess.Popen(argv, env=self.env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        self.procs.append(p)
+        return p
+
+
+def _run_drill(tmp_path, steps, every, sig, sup_kwargs,
+               min_lines_before_kill, rto_bound_s=90.0):
+    """Start the drill trainer under a supervisor, kill it once it has
+    made progress, and return (exit_code, supervisor, runner, rto_s)."""
+    import threading
+
+    prog = str(tmp_path / "progress.log")
+    env = dict(os.environ, **_ENV)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))] +
+        env.get("PYTHONPATH", "").split(os.pathsep))
+    env.update(DRILL_CKPT=str(tmp_path / "ckpt"), DRILL_PROG=prog,
+               DRILL_STEPS=str(steps), DRILL_EVERY=str(every),
+               DRILL_SLEEP="0.15")
+    script = tmp_path / "drill_trainer.py"
+    script.write_text(_DRILL_TRAINER)
+    runner = _CapturingRunner(env)
+    sup = ElasticSupervisor("localhost:2",
+                            [sys.executable, str(script)],
+                            ports=(0,), verbose=0, runner=runner,
+                            **sup_kwargs)
+    box = []
+    sup.start()
+    waiter = threading.Thread(target=lambda: box.append(
+        sup.wait(poll_s=0.1)), daemon=True)
+    waiter.start()
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and \
+                len(_progress_lines(prog)) < min_lines_before_kill:
+            time.sleep(0.05)
+        lines_at_kill = _progress_lines(prog)
+        assert len(lines_at_kill) >= min_lines_before_kill, \
+            "drill trainer made no progress before the kill"
+        os.kill(runner.procs[-1].pid, sig)
+        t_kill = time.monotonic()
+        # RTO: wall clock from kill to the restarted job's first NEW step
+        rto = None
+        deadline = t_kill + rto_bound_s
+        while time.monotonic() < deadline:
+            lines = _progress_lines(prog)
+            if lines and lines[-1][0] > lines_at_kill[-1][0]:
+                rto = time.monotonic() - t_kill
+                break
+            time.sleep(0.05)
+        assert rto is not None, (
+            f"no recovery within the {rto_bound_s:.0f}s RTO bound after "
+            f"{signal.Signals(sig).name}")
+        waiter.join(timeout=120.0)
+        assert box, "supervised job never reached a terminal exit"
+        return box[0], sup, runner, rto
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.chaos
+class TestDrillCheckpointRestart:
+    def test_sigkill_bounded_rto_and_exact_loss_trajectory(self,
+                                                           tmp_path):
+        """Drill (g), the checkpoint plane's reason to exist: SIGKILL a
+        training process mid-run — no handler, no goodbye — under a
+        supervisor consuming crashes. Recovery must be bounded in time,
+        and the completed run's loss trajectory must match the
+        uninterrupted run EXACTLY: same steps, same floats. Anything
+        else means resume restored the wrong weights, step, or data
+        position."""
+        rc, sup, runner, rto = _run_drill(
+            tmp_path, steps=10, every=1, sig=signal.SIGKILL,
+            sup_kwargs=dict(auto_shrink_rc=-signal.SIGKILL),
+            min_lines_before_kill=3)
+        assert rc == 0
+        assert sup.restarts == 1 and len(runner.procs) == 2
+        assert rto < 90.0, f"RTO {rto:.1f}s"
+        lines = _progress_lines(str(tmp_path / "progress.log"))
+        # a SIGKILL between the progress write and the step_end() commit
+        # legally re-runs that one step after resume; the LAST occurrence
+        # of every step is the run's verdict
+        final = dict(lines)
+        expect = dict(_drill_trajectory(10))
+        assert sorted(final) == sorted(expect), \
+            f"missing/extra steps: got {sorted(final)}"
+        for s in expect:
+            assert abs(final[s] - expect[s]) < 1e-12, (
+                f"loss diverged at step {s}: {final[s]!r} != "
+                f"{expect[s]!r} — resume restored the wrong state")
+        # each step ran at most twice (the in-flight one), never more
+        seen = [s for s, _ in lines]
+        assert all(seen.count(s) <= 2 for s in set(seen))
+
+    def test_sigterm_preemption_exits_45_and_no_step_reruns(self,
+                                                            tmp_path):
+        """Drill (h), preemption-safe exit: SIGTERM must let the
+        in-flight step finish, commit an EMERGENCY checkpoint (the
+        periodic cadence is every=3 — without the emergency save, steps
+        would re-run), exit PREEMPTED_EXIT_CODE, and restart with the
+        SAME slots via graceful_restart_rc. The emergency save makes
+        resume exact: every step appears EXACTLY once."""
+        from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+        rc, sup, runner, rto = _run_drill(
+            tmp_path, steps=9, every=3, sig=signal.SIGTERM,
+            sup_kwargs=dict(graceful_restart_rc=PREEMPTED_EXIT_CODE),
+            min_lines_before_kill=4)
+        assert rc == 0
+        assert sup.restarts == 1 and len(runner.procs) == 2
+        assert runner.procs[0].wait() == PREEMPTED_EXIT_CODE
+        assert sup.current_total == 2  # graceful restart never shrinks
+        lines = _progress_lines(str(tmp_path / "progress.log"))
+        seen = [s for s, _ in lines]
+        assert seen == list(range(1, 10)), (
+            f"steps must each run exactly once (emergency checkpoint "
+            f"resumes at the exact boundary): {seen}")
+        expect = dict(_drill_trajectory(9))
+        for s, loss in lines:
+            assert abs(loss - expect[s]) < 1e-12

@@ -1,9 +1,15 @@
 """Checkpoint save/restore round-trip + resume consistency
-(reference app-level pattern, examples/pytorch_mnist.py:175-195)."""
+(reference app-level pattern, examples/pytorch_mnist.py:175-195), plus
+the checkpoint plane (docs/checkpoint.md): async double-buffered saves,
+sharded per-rank writes with a single manifest commit point, fail-loud
+integrity, M->N reshard, retention GC, and the save-interruption
+torture matrix."""
 
 import os
+import threading
 
 import numpy as np
+import pytest
 
 
 def test_save_restore_roundtrip(hvd, tmp_path):
@@ -63,3 +69,289 @@ def test_restore_falls_back_to_old_after_interrupted_overwrite(hvd, tmp_path):
     restored, step = checkpoint.restore(path, like={"x": np.zeros(2)})
     assert step == 1
     np.testing.assert_allclose(restored["x"], np.full(2, 1.0))
+
+
+def test_latest_step_reads_old_fallback(hvd, tmp_path):
+    """Regression: latest_step() used to open <path>/manifest.json even
+    when only <path>.old survived the crash window exists() accepts —
+    a FileNotFoundError exactly when the caller is deciding whether it
+    can resume."""
+    from horovod_tpu.utils import checkpoint
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, {"x": np.zeros(2)}, step=9)
+    os.replace(path, path + ".old")
+    assert checkpoint.exists(path)
+    assert checkpoint.latest_step(path) == 9
+    assert checkpoint.latest_step(str(tmp_path / "nothing")) is None
+
+
+def test_restore_like_mismatch_fails_loud(hvd, tmp_path):
+    """A model that changed shape between save and resume must refuse to
+    restore, naming the differing leaves — not silently unflatten a
+    scrambled tree."""
+    from horovod_tpu.common.exceptions import CheckpointError
+    from horovod_tpu.utils import checkpoint
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, {"w": np.zeros(2), "b": np.ones(3)}, step=1)
+    with pytest.raises(CheckpointError, match="mismatch") as ei:
+        checkpoint.restore(path, like={"w": np.zeros(2),
+                                       "extra_head": np.zeros(4)})
+    assert "extra_head" in str(ei.value)
+    assert "b" in str(ei.value)
+    # like=None stays the raw-dict escape hatch
+    raw, step = checkpoint.restore(path)
+    assert step == 1 and len(raw) == 2
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager (format 2)
+# ---------------------------------------------------------------------------
+
+TREE = {"w": np.arange(6.0).reshape(2, 3),
+        "opt": {"m": np.ones(4), "v": np.full(4, 0.5)},
+        "step_scale": np.float32(1.5)}
+
+
+def _bump(tree, k):
+    return {key: ({kk: vv + k for kk, vv in val.items()}
+                  if isinstance(val, dict) else val + k)
+            for key, val in tree.items()}
+
+
+def test_manager_sync_roundtrip_with_extra(hvd, tmp_path):
+    from horovod_tpu.utils import checkpoint
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "c"), async_save=False)
+    d = mgr.save(TREE, step=12, extra={"data_pos": 12, "rng": [0, 7]})
+    assert d is not None and os.path.exists(os.path.join(d, "manifest.json"))
+    assert mgr.latest_step() == 12
+    tree, step, extra = mgr.restore(like=TREE)
+    assert step == 12 and extra == {"data_pos": 12, "rng": [0, 7]}
+    np.testing.assert_allclose(tree["opt"]["v"], np.full(4, 0.5))
+    # module-level restore reads format 2 transparently
+    tree2, step2 = checkpoint.restore(str(tmp_path / "c"), like=TREE)
+    assert step2 == 12
+    np.testing.assert_allclose(np.asarray(tree2["w"]), TREE["w"])
+    mgr.close()
+
+
+def test_manager_async_drains_and_drops_stale_snapshots(hvd, tmp_path):
+    """Latest-wins buffer: the step loop never stalls on a slow disk;
+    superseded snapshots are dropped and counted, the newest always
+    lands."""
+    from horovod_tpu.utils import checkpoint
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "c"), keep=0)
+    assert mgr.async_save
+    gate = threading.Event()
+    checkpoint._FAILPOINTS["pre_shard"] = gate.wait
+    try:
+        mgr.save(_bump(TREE, 1), step=1)
+        for s in range(2, 6):  # all queued behind the stalled writer
+            mgr.save(_bump(TREE, s), step=s)
+    finally:
+        checkpoint._FAILPOINTS.clear()
+        gate.set()
+    mgr.wait(timeout=30)
+    mgr.close()
+    committed = sorted(checkpoint._committed_steps(str(tmp_path / "c")))
+    assert committed[-1] == 5  # newest snapshot always survives
+    assert 2 <= len(committed) <= 3  # stale queued ones were dropped
+    tree, step, _ = checkpoint.CheckpointManager(
+        str(tmp_path / "c")).restore(like=TREE)
+    assert step == 5
+    np.testing.assert_allclose(tree["opt"]["m"], np.ones(4) + 5)
+
+
+def test_manager_retention_keeps_last_k(hvd, tmp_path):
+    from horovod_tpu.utils import checkpoint
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "c"), keep=2,
+                                       async_save=False)
+    for s in (3, 7, 11, 15):
+        mgr.save(_bump(TREE, s), step=s)
+    mgr.close()
+    assert sorted(checkpoint._committed_steps(str(tmp_path / "c"))) == \
+        [11, 15]
+    # restore(step=...) names the committed steps when asked for a GC'd one
+    with pytest.raises(FileNotFoundError, match=r"\[11, 15\]"):
+        checkpoint.restore(str(tmp_path / "c"), like=TREE, step=3)
+
+
+def test_manager_sharded_save_reshards_into_any_world(hvd, tmp_path):
+    """3 ranks write round-robin shards; restore reassembles the full
+    tree regardless of the restore-time world size (M->N elastic
+    restart)."""
+    from horovod_tpu.utils import checkpoint
+    root = str(tmp_path / "c")
+    mgrs = [checkpoint.CheckpointManager(root, rank=r, world_size=3,
+                                         async_save=False)
+            for r in range(3)]
+    errs = []
+
+    def run(m):
+        try:
+            m.save(_bump(TREE, 2), step=4, extra={"data_pos": 4})
+        except Exception as e:  # noqa: BLE001 — surfaced via errs below
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(m,)) for m in mgrs[1:]]
+    for t in threads:
+        t.start()
+    mgrs[0].save(_bump(TREE, 2), step=4, extra={"data_pos": 4})
+    for t in threads:
+        t.join()
+    assert not errs
+    d = checkpoint._committed_steps(root)[4]
+    shards = [f for f in os.listdir(d) if f.endswith(".npz")]
+    assert len(shards) == 3  # every rank wrote its own shard
+    # restore-time world size is irrelevant: any manager (or the module
+    # function) reads all save-time shards
+    for world in (1, 2, 5):
+        mgr = checkpoint.CheckpointManager(root, rank=0, world_size=world)
+        tree, step, extra = mgr.restore(like=TREE)
+        assert step == 4 and extra == {"data_pos": 4}
+        np.testing.assert_allclose(tree["w"], TREE["w"] + 2)
+        np.testing.assert_allclose(tree["opt"]["v"], TREE["opt"]["v"] + 2)
+
+
+def test_manager_commit_waits_for_all_ranks(hvd, tmp_path):
+    """Rank 0 must NOT commit until every peer's manifest exists: a rank
+    dying mid-save leaves the checkpoint uncommitted, not half-valid."""
+    from horovod_tpu.common.exceptions import CheckpointError
+    from horovod_tpu.utils import checkpoint
+    root = str(tmp_path / "c")
+    mgr0 = checkpoint.CheckpointManager(root, rank=0, world_size=2,
+                                        async_save=False,
+                                        commit_timeout_s=0.3)
+    with pytest.raises(CheckpointError, match="never appeared"):
+        mgr0.save(TREE, step=1)  # rank 1 never shows up
+    assert not checkpoint._committed_steps(root)
+    assert not checkpoint.exists(root)
+
+
+def test_manager_corruption_fails_loud(hvd, tmp_path):
+    from horovod_tpu.common.exceptions import CorruptCheckpointError
+    from horovod_tpu.utils import checkpoint
+    root = str(tmp_path / "c")
+    mgr = checkpoint.CheckpointManager(root, async_save=False)
+    d = mgr.save(TREE, step=2)
+    shard = os.path.join(d, "rank00000.npz")
+    blob = bytearray(open(shard, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # single flipped bit, same size
+    with open(shard, "wb") as f:
+        f.write(blob)
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        mgr.restore(like=TREE)
+    # truncation is caught by the recorded size before the crc pass
+    with open(shard, "wb") as f:
+        f.write(blob[:-10])
+    with pytest.raises(CorruptCheckpointError, match="bytes"):
+        mgr.restore(like=TREE)
+
+
+def test_manager_verify_false_skips_checksums(hvd, tmp_path):
+    """verify=False is the explicit escape hatch (trusted local disk):
+    a manifest whose RECORDED crc is wrong fails verification but the
+    intact data still restores when verification is skipped."""
+    import json
+
+    from horovod_tpu.common.exceptions import CorruptCheckpointError
+    from horovod_tpu.utils import checkpoint
+    root = str(tmp_path / "c")
+    mgr = checkpoint.CheckpointManager(root, async_save=False)
+    d = mgr.save(TREE, step=2)
+    mpath = os.path.join(d, "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest["files"]["rank00000.npz"]["crc"] ^= 1
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        mgr.restore(like=TREE)
+    tree, step, _ = mgr.restore(like=TREE, verify=False)
+    assert step == 2
+    np.testing.assert_allclose(tree["w"], TREE["w"])
+
+
+def test_manager_v2_like_mismatch_fails_loud(hvd, tmp_path):
+    from horovod_tpu.common.exceptions import CheckpointError
+    from horovod_tpu.utils import checkpoint
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "c"),
+                                       async_save=False)
+    mgr.save(TREE, step=1)
+    with pytest.raises(CheckpointError, match="mismatch"):
+        mgr.restore(like={"w": np.zeros((2, 3))})
+
+
+def test_manager_async_writer_error_reaches_the_train_loop(hvd, tmp_path):
+    """The writer thread cannot stop the job itself; its failure must
+    surface on the next save()/wait()/close() call instead of rotting
+    silently while the job runs on with no durability."""
+    from horovod_tpu.common.exceptions import CheckpointError
+    from horovod_tpu.utils import checkpoint
+
+    def boom():
+        raise OSError(28, "No space left on device")
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "c"))
+    checkpoint._FAILPOINTS["pre_commit"] = boom
+    try:
+        mgr.save(TREE, step=1)
+        with pytest.raises(CheckpointError, match="No space left"):
+            mgr.wait(timeout=30)
+    finally:
+        checkpoint._FAILPOINTS.clear()
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# save-interruption torture matrix (satellite of the commit protocol):
+# kill the writer at EVERY failure point; restore() must always return a
+# complete, checksum-valid checkpoint — the previous commit for any
+# interruption before the manifest rename, the new one at/after it.
+# ---------------------------------------------------------------------------
+
+_POINTS = {  # failpoint -> step restore() must see afterwards
+    "pre_shard": 1, "post_shard": 1, "pre_rank_manifest": 1,
+    "post_rank_manifest": 1, "pre_commit": 1, "mid_commit": 1,
+    "post_commit": 2,
+}
+
+
+class _Torture(RuntimeError):
+    pass
+
+
+@pytest.mark.parametrize("point", sorted(_POINTS))
+def test_torture_save_interrupted_at_every_point(hvd, tmp_path, point):
+    from horovod_tpu.utils import checkpoint
+    root = str(tmp_path / "c")
+    mgr = checkpoint.CheckpointManager(root, async_save=False, keep=4)
+    mgr.save(_bump(TREE, 1), step=1)
+
+    def boom():
+        raise _Torture(point)
+
+    checkpoint._FAILPOINTS[point] = boom
+    try:
+        with pytest.raises(_Torture):
+            mgr.save(_bump(TREE, 2), step=2)
+    finally:
+        checkpoint._FAILPOINTS.clear()
+
+    # the surviving checkpoint is complete and checksum-valid
+    want = _POINTS[point]
+    tree, step, _ = mgr.restore(like=TREE, verify=True)
+    assert step == want
+    np.testing.assert_allclose(tree["w"], TREE["w"] + want)
+    # no torn commit: every committed dir passes full verification
+    for s, d in checkpoint._committed_steps(root).items():
+        checkpoint._verify_files(d, checkpoint._read_global_manifest(d))
+
+    # recovery: the next save commits and GC clears any dead partial
+    mgr.save(_bump(TREE, 3), step=3)
+    tree, step, _ = mgr.restore(like=TREE, verify=True)
+    assert step == 3
+    committed = checkpoint._committed_steps(root)
+    for name in os.listdir(root):
+        if name.startswith("step-"):
+            s = int(name.split("-")[1])
+            assert s in committed, f"uncommitted partial {name} survived GC"
+    mgr.close()

@@ -110,3 +110,145 @@ class TestElasticSupervisor:
             ports=tuple(range(15110, 15120)), verbose=0)
         sup.start()
         assert sup.wait(poll_s=0.1) == 3
+
+    def test_recv_message_reassembles_split_tcp_segments(self):
+        """TCP is a byte stream: one recv() may return any prefix of the
+        peer's message. A '12' sent as '1' then '2' must parse as twelve
+        slots, not one (the truncation bug this helper replaced)."""
+        a, b = socket.socketpair()
+        try:
+            out = {}
+
+            def read():
+                out["msg"] = ElasticSupervisor._recv_message(a)
+
+            import threading
+            t = threading.Thread(target=read)
+            t.start()
+            b.sendall(b"1")
+            time.sleep(0.1)  # force the second segment into its own recv
+            b.sendall(b"2\n")
+            b.close()
+            t.join(timeout=5)
+            assert out["msg"] == b"12"
+        finally:
+            a.close()
+
+    def test_recv_message_bounds_size_and_time(self):
+        a, b = socket.socketpair()
+        try:
+            b.sendall(b"9" * 200)
+            b.close()
+            with pytest.raises(ValueError, match="exceeds"):
+                ElasticSupervisor._recv_message(a)
+        finally:
+            a.close()
+        # a peer that connects and never closes hits the socket timeout
+        a, b = socket.socketpair()
+        try:
+            b.sendall(b"3")
+            with pytest.raises(OSError):
+                ElasticSupervisor._recv_message(a, timeout_s=0.2)
+        finally:
+            a.close()
+            b.close()
+
+    def test_listener_survives_malformed_message(self, tmp_path):
+        """Garbage on the control port must not kill the supervisor or
+        the job; a later well-formed (even split-across-segments)
+        message still works."""
+        log = tmp_path / "runs.log"
+        script = tmp_path / "job.py"
+        script.write_text(
+            "import sys, time\n"
+            "open(sys.argv[1], 'a').write(sys.argv[2] + '\\n')\n"
+            "time.sleep(60)\n")
+        sup = ElasticSupervisor(
+            "localhost:4",
+            [sys.executable, str(script), str(log), "np={np}"],
+            ports=tuple(range(15120, 15130)), verbose=0)
+        sup.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not log.exists():
+                time.sleep(0.1)
+            for junk in (b"not a number", b"", b"2.5"):
+                with socket.create_connection(("127.0.0.1",
+                                               sup.port)) as s:
+                    s.sendall(junk)
+            # the valid request still lands, split across two segments
+            with socket.create_connection(("127.0.0.1", sup.port)) as s:
+                s.sendall(b" ")
+                time.sleep(0.1)
+                s.sendall(b"2\n")
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    log.read_text().count("\n") < 2:
+                time.sleep(0.1)
+            assert log.read_text() == "np=4\nnp=2\n"
+            assert sup.restarts == 1
+            assert sup._exit_code == 0  # junk never tripped the error path
+        finally:
+            sup.shutdown()
+
+    def test_graceful_restart_on_preempted_exit(self):
+        """PREEMPTED_EXIT_CODE restarts with the SAME slots (the machine
+        went away; the allocation did not) — no shrink, unlike
+        auto_shrink_rc."""
+        from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+
+        class _ExitedProc:
+            def __init__(self, rc):
+                self._rc = rc
+                self.pid = 4242
+
+            def wait(self, timeout=None):
+                return self._rc
+
+            def poll(self):
+                return self._rc
+
+        codes = [PREEMPTED_EXIT_CODE, PREEMPTED_EXIT_CODE, 0]
+        calls = []
+
+        def runner(argv):
+            calls.append(list(argv))
+            return _ExitedProc(codes.pop(0))
+
+        sup = ElasticSupervisor(
+            "a:2,b:2", ["job", "{np}", "{bpa}", "{restart}"],
+            ports=(0,), verbose=0, runner=runner,
+            graceful_restart_rc=PREEMPTED_EXIT_CODE)
+        try:
+            sup.start()
+            assert sup.wait(poll_s=0.01) == 0
+        finally:
+            sup.shutdown()
+        assert sup.restarts == 2
+        assert sup.current_total == 4  # never shrank
+        assert [c[1] for c in calls] == ["4", "4", "4"]  # same np each time
+        assert [c[3] for c in calls] == ["0", "1", "2"]  # restart ordinal
+
+    def test_graceful_restart_bounded_by_max_restarts(self):
+        from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+
+        class _ExitedProc:
+            pid = 4242
+
+            def wait(self, timeout=None):
+                return PREEMPTED_EXIT_CODE
+
+            def poll(self):
+                return PREEMPTED_EXIT_CODE
+
+        sup = ElasticSupervisor(
+            "a:2", ["job"], ports=(0,), verbose=0,
+            runner=lambda argv: _ExitedProc(),
+            graceful_restart_rc=PREEMPTED_EXIT_CODE, max_restarts=3)
+        try:
+            sup.start()
+            # a job that ALWAYS exits preempted stops after max_restarts
+            assert sup.wait(poll_s=0.01) == PREEMPTED_EXIT_CODE
+        finally:
+            sup.shutdown()
+        assert sup.restarts == 3

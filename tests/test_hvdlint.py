@@ -718,6 +718,72 @@ def test_hvd011_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD012 — ad-hoc training-state serialization
+# ---------------------------------------------------------------------------
+
+def test_hvd012_triggers_on_numpy_and_torch_dumps(tmp_path):
+    found = lint_source(tmp_path, """\
+        import numpy as np
+        import torch
+
+        def dump(path, params, model):
+            np.savez(path, **params)
+            np.savez_compressed(path + ".z", **params)
+            np.save(path + ".npy", params["w"])
+            torch.save(model.state_dict(), path + ".pt")
+        """)
+    assert [f.rule for f in live(found)] == ["HVD012"] * 4
+
+
+def test_hvd012_sanctioned_checkpoint_module_is_clean(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "utils"
+    mod.mkdir(parents=True)
+    f = mod / "checkpoint.py"
+    f.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def write_shard(path, arrays):
+            np.savez(path, **arrays)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd012_non_dump_writes_are_clean(tmp_path):
+    # json/pickle scratch and this repo's own checkpoint entry points
+    # are not array dumps; np.save needs the np receiver to count
+    found = lint_source(tmp_path, """\
+        import json
+        import pickle
+        from horovod_tpu.utils import checkpoint
+
+        def scratch(path, obj, tree):
+            json.dump(obj, open(path, "w"))
+            pickle.dumps(obj)
+            checkpoint.save(path, tree)
+
+        def save(path, obj):
+            return path, obj
+        """)
+    assert live(found) == []
+
+
+def test_hvd012_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        import numpy as np
+
+        def export_onnx_weights(path, arrays):
+            # hvdlint: disable=HVD012(interchange export, not durable training state)
+            np.savez(path, **arrays)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD012"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -777,7 +843,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 12)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 13)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

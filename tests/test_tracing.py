@@ -220,6 +220,44 @@ class TestLifecycleAndGates:
         monkeypatch.setattr(hvd_tracing, "_sigterm_installed", False)
         assert hvd_tracing.install_signal_dump() is False
 
+    def test_sigterm_dump_defers_to_later_wrapping_handler(self, tmp_path):
+        """The dump handler re-delivers SIGTERM (SIG_DFL) only while it is
+        the OUTERMOST disposition. When a later-installed handler wraps it
+        — the Checkpointer's preemption flag chains to it for the dump —
+        re-delivering would kill the process mid-step and break the
+        finish-step -> emergency-save -> exit-45 contract. Subprocess:
+        a regression here terminates the victim, not the test run."""
+        import subprocess
+        script = (
+            "import os, signal, sys\n"
+            "from horovod_tpu.utils import tracing\n"
+            "tracing.reset(enabled=True, rank=0)\n"
+            "assert tracing.install_signal_dump()\n"
+            "flag = []\n"
+            "prev = signal.getsignal(signal.SIGTERM)\n"
+            "def outer(signum, frame):\n"
+            "    flag.append(signum)\n"
+            "    prev(signum, frame)\n"
+            "signal.signal(signal.SIGTERM, outer)\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "assert flag, 'outer handler must have run'\n"
+            "print('SURVIVED')\n")
+        env = dict(os.environ, HVD_FLIGHT_DIR=str(tmp_path))
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "SURVIVED" in proc.stdout
+        # and alone — no wrapper — it still re-delivers: exit by SIGTERM
+        solo = (
+            "import os, signal\n"
+            "from horovod_tpu.utils import tracing\n"
+            "tracing.reset(enabled=True, rank=0)\n"
+            "assert tracing.install_signal_dump()\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n")
+        proc = subprocess.run([sys.executable, "-c", solo], env=env,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == -signal.SIGTERM
+
 
 # -- postmortem merge math --------------------------------------------------
 

@@ -329,6 +329,49 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         lines.append(f"    steps {scount:>8,}   mean {_fmt_s(ssum / scount):>8}"
                      f"   p50 {_fmt_s(sp50):>8}   tokens/s {tps:,.0f}")
 
+    # checkpoint plane: durability at a glance — how stale is the last
+    # commit, and is the async writer keeping up (drops) or corrupting
+    # (restore outcomes). (horovod_tpu/utils/checkpoint.py;
+    # docs/checkpoint.md)
+    saves = _by_label(snap, "hvd_ckpt_saves_total", "kind")
+    restores = _by_label(snap, "hvd_ckpt_restores_total", "outcome")
+    if saves or restores:
+        lines.append(c(BOLD, "  checkpoint"))
+        last_ts = _total(snap, "hvd_ckpt_last_save_ts_seconds")
+        age = None
+        if last_ts:
+            age = max(0.0,
+                      hvd_metrics.shared_clock().epoch_us() / 1e6 - last_ts)
+        save_line = (f"    saves         "
+                     + "  ".join(f"{k}={int(v):,}"
+                                 for k, v in sorted(saves.items()))
+                     + f"   last step {int(_total(snap, 'hvd_ckpt_last_step')):,}"
+                     f"   age {_fmt_s(age)}")
+        # stale commit = the thing a durability operator must not miss
+        lines.append(c(YELLOW, save_line)
+                     if age is not None and age > 600 else save_line)
+        ch = _hist(snap, "hvd_ckpt_save_seconds")
+        bh = _hist(snap, "hvd_ckpt_block_seconds")
+        if ch and ch[3]:
+            bounds, counts, hsum, hcount = ch
+            cp50 = hvd_metrics.histogram_quantile(bounds, counts, 0.5)
+            cp99 = hvd_metrics.histogram_quantile(bounds, counts, 0.99)
+            block_p99 = None
+            if bh and bh[3]:
+                block_p99 = hvd_metrics.histogram_quantile(bh[0], bh[1],
+                                                           0.99)
+            lines.append(f"    write         "
+                         f"bytes {_fmt_bytes(_total(snap, 'hvd_ckpt_bytes_total')):>12}"
+                         f"   p50 {_fmt_s(cp50):>8}   p99 {_fmt_s(cp99):>8}"
+                         f"   step-block p99 {_fmt_s(block_p99)}")
+        corrupt = restores.get("corrupt", 0)
+        dropped = _total(snap, "hvd_ckpt_dropped_snapshots_total")
+        hk_line = (f"    restores      ok {int(restores.get('ok', 0)):,}   "
+                   f"corrupt {int(corrupt):,}   "
+                   f"dropped snapshots {int(dropped):,}   "
+                   f"gc {int(_total(snap, 'hvd_ckpt_gc_total')):,}")
+        lines.append(c(RED, hk_line) if corrupt else hk_line)
+
     # serving plane: admission, occupancy, SLO latencies
     # (horovod_tpu/serving/; docs/serving.md)
     sreq = _by_label(snap, "hvd_serve_requests_total", "outcome")
@@ -483,6 +526,25 @@ def canned_snapshot():
     reg.gauge("hvd_compression_norm_delta", "g",
               labels=("tensor", "compressor")).labels(
         tensor="grad/embed", compressor="fp16").set(3.1e-4)
+    cs = reg.counter("hvd_ckpt_saves_total", "c", labels=("kind",))
+    cs.labels(kind="async").inc(41)
+    cs.labels(kind="emergency").inc(1)
+    reg.counter("hvd_ckpt_bytes_total", "c").inc(9_800_000_000)
+    csh = reg.histogram("hvd_ckpt_save_seconds", "h")
+    for v in (0.8, 1.1, 1.4, 3.2):
+        for _ in range(10):
+            csh.observe(v)
+    cbh = reg.histogram("hvd_ckpt_block_seconds", "h")
+    for v in (0.002, 0.004, 0.009):
+        for _ in range(14):
+            cbh.observe(v)
+    reg.gauge("hvd_ckpt_last_step", "g").set(4100)
+    reg.gauge("hvd_ckpt_last_save_ts_seconds", "g").set(
+        hvd_metrics.shared_clock().epoch_us() / 1e6 - 42.0)
+    reg.counter("hvd_ckpt_dropped_snapshots_total", "c").inc(2)
+    reg.counter("hvd_ckpt_gc_total", "c").inc(38)
+    cr = reg.counter("hvd_ckpt_restores_total", "c", labels=("outcome",))
+    cr.labels(outcome="ok").inc(2)
     sq = reg.counter("hvd_serve_requests_total", "c", labels=("outcome",))
     sq.labels(outcome="completed").inc(1840)
     sq.labels(outcome="rejected").inc(12)

@@ -993,6 +993,44 @@ def check_decode_host_sync(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD012 — ad-hoc training-state serialization outside the checkpoint plane
+# ---------------------------------------------------------------------------
+
+# array-dump entry points that write training state to disk without the
+# checkpoint plane's commit protocol (atomic rename, checksums, manifest)
+_SERIALIZE_CALL_NAMES = {"save", "savez", "savez_compressed"}
+_SERIALIZE_RECEIVERS = {"np", "numpy", "onp", "jnp", "torch"}
+_CKPT_SANCTIONED_SUFFIXES = ("horovod_tpu/utils/checkpoint.py",)
+
+
+def check_adhoc_serialization(ctx, shared):
+    if ctx.relpath.endswith(_CKPT_SANCTIONED_SUFFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or len(chain) < 2:
+            continue
+        if chain[-1] in _SERIALIZE_CALL_NAMES and \
+                chain[0] in _SERIALIZE_RECEIVERS:
+            call = ".".join(chain)
+            yield Finding(
+                "HVD012", ctx.relpath, node.lineno, node.col_offset,
+                f"ad-hoc training-state serialization '{call}(...)' "
+                "outside the checkpoint plane: a bare array dump has no "
+                "atomic commit (a crash mid-write leaves a torn file "
+                "that loads as garbage), no checksums (bit rot restores "
+                "silently), no manifest (restores cannot validate "
+                "completeness), and no retention/GC. Route durable "
+                "state through utils/checkpoint.py — "
+                "CheckpointManager.save for the step loop, "
+                "checkpoint.save for one-shot dumps — so every byte on "
+                "disk rides the commit protocol docs/checkpoint.md "
+                "documents and the torture tests exercise.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1275,5 +1313,40 @@ a readback is genuinely the loop's output, batch it with the
 sanctioned per-step one, or carry a disable comment stating why one
 more rendezvous per token is acceptable.""",
             check_decode_host_sync),
+        Rule(
+            "HVD012", "ad-hoc-state-serialization",
+            "np/torch array dump outside the checkpoint plane",
+            """HVD012 — ad-hoc training-state serialization
+
+The checkpoint plane (utils/checkpoint.py, PR 10) makes exactly one
+promise: anything it committed, restore() returns complete and
+checksum-valid — or fails loud. The machinery behind that promise is
+all in one place: tmp + fsync + atomic rename for every file, per-file
+CRCs recorded in a manifest whose own rename is THE commit point,
+restore-side verification, keep-last-K retention, and a torture test
+that kills the writer at every failure point and asserts the promise
+anyway.
+
+A stray ``np.savez(path, **params)`` in an op or a trainer keeps none
+of it. A crash mid-write leaves a torn .npz that numpy happily opens
+and fails inside lazily; a full disk truncates silently; nothing
+records what SHOULD be in the file, so a partial write restores as a
+partial model — the failure mode that costs a week of training, found
+only when the loss curve disagrees with the logbook. The historical
+shape: a quick "dump the weights here" during an experiment that
+becomes the de-facto checkpoint path.
+
+Flags ``save/savez/savez_compressed`` calls received by np/numpy/onp/
+jnp/torch in every module except utils/checkpoint.py (the sanctioned
+home). Bare-name calls and pickle are NOT flagged: optim/cache/network
+legitimately pickle for the wire and for non-durable scratch, and a
+bare ``save(...)`` is usually this repo's own checkpoint.save. Tests
+and examples are outside the lint scope.
+
+Fix: ``CheckpointManager(dir).save(tree, step)`` for the training
+loop (async, sharded, preemption-safe); ``checkpoint.save(path,
+tree)`` for one-shot dumps. Both give you the commit protocol for
+free.""",
+            check_adhoc_serialization),
     ]
 }
