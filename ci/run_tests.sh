@@ -80,6 +80,19 @@ echo "--- checkpoint plane (fast fail: commit protocol, torture matrix, reshard)
 # drills ride test_chaos_plane.py with the other drills.
 python -m pytest tests/test_checkpoint.py -q -m "not slow"
 
+echo "--- perf attribution (fast fail: overlap math, roofline model, regression ledger)"
+# The perf-attribution plane (docs/profiling.md) is how every other
+# plane's "is it fast enough" question gets answered: trace
+# decomposition + overlap accounting, the analytic roofline/MFU model,
+# and the ledger that compares bench runs. All process-local math, runs
+# in seconds. The ledger then replays the checked-in BENCH_r*.json
+# history so a perf regression (or a schema break in bench output)
+# fails CI before the half-hour suite — config changes between rounds
+# are recognized by context fields, not flagged.
+python -m pytest tests/test_profiling.py tests/test_costmodel.py \
+    tests/test_hvd_perf.py -q -m "not slow"
+python tools/hvd_perf.py --check BENCH_r*.json
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

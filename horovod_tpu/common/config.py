@@ -275,6 +275,11 @@ ENV_REGISTRY = (
      "Seconds between rank-0 metrics aggregation pulls."),
     ("HOROVOD_METRICS_PORT", True, "0", "common/config.py",
      "Rank-0 HTTP port for /metrics and /metrics.json (0 disables)."),
+    ("HOROVOD_PERF_ATTRIB_EVERY", True, "0", "trainer.py",
+     "Capture + attribute every Nth instrumented step (profiler trace "
+     "-> per-class hvd_step_breakdown_ms / overlap gauges); 0 (the "
+     "default) keeps the capture off the hot path. ~64 keeps the "
+     "amortized cost inside the 2% bench budget."),
     ("HOROVOD_NUMERICS", True, "1", "utils/numerics.py",
      "Set 0 to replace the numerics plane (gradient health stats + "
      "divergence sentinel) with no-ops."),
@@ -414,6 +419,12 @@ ENV_REGISTRY = (
      "Force the flash-attention ablation legs on (1) or off (0)."),
     ("HVD_BENCH_FLIGHT", False, None, "bench.py",
      "Set 0 to skip the flight-recorder overhead gate in bench.py."),
+    ("HVD_BENCH_LABEL", False, None, "bench.py",
+     "Free-form run label stamped into the bench JSON provenance "
+     "(shows up as the run name in tools/hvd_perf.py reports)."),
+    ("HVD_BENCH_PERF", False, None, "bench.py",
+     "Set 0 to skip the perf-attribution overhead gate (periodic "
+     "instrument_step capture amortized <=2% vs attribution off)."),
     ("HVD_BENCH_NUMERICS", False, None, "bench.py",
      "Set 0 to skip the numerics-overhead gate in bench.py."),
     ("HVD_BENCH_QUANT", False, None, "bench.py",
@@ -422,6 +433,9 @@ ENV_REGISTRY = (
     ("HVD_BENCH_SERVE", False, None, "bench.py",
      "Set 0 to skip the serving bench leg (continuous vs static "
      "batching under Poisson load, p50/p99 TTFT)."),
+    ("HVD_PERF_THRESHOLD_PCT", False, "5.0", "tools/hvd_perf.py",
+     "Default regression threshold (percent) for the hvd_perf bench-"
+     "trajectory gate; per-leg noise bands can only raise it."),
     ("HVD_TEST_WORKERS", False, "auto", "ci/run_tests.sh",
      "pytest-xdist worker count for the CI suite."),
 )

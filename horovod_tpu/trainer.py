@@ -33,7 +33,8 @@ from .utils import metrics as hvd_metrics
 from .utils import tracing as hvd_tracing
 
 
-def instrument_step(step_fn, tokens_per_step=None, name="train"):
+def instrument_step(step_fn, tokens_per_step=None, name="train",
+                    flops_per_token=None, attrib_every=None, spec=None):
     """Wrap a compiled train step with step-path telemetry: an
     ``hvd_step_seconds`` histogram, an ``hvd_steps_total`` counter and —
     when ``tokens_per_step`` is given — an ``hvd_tokens_per_second``
@@ -46,6 +47,25 @@ def instrument_step(step_fn, tokens_per_step=None, name="train"):
     (make_gspmd_step, whose callers read the loss every step anyway), wrong
     inside a scanned multi-step. Disabled metrics make this a plain
     passthrough of the original function.
+
+    Two optional attribution layers (the perf-attribution plane):
+
+      * ``flops_per_token`` (e.g. ``models.transformer
+        .matmul_flops_per_token``) with ``tokens_per_step`` publishes a
+        live per-step ``hvd_mfu`` gauge against the chip's peak
+        (``spec`` — a ``costmodel.ChipSpec``, auto-detected from the
+        local device when omitted; no gauge off-TPU, where the CPU
+        spec's placeholder peak would make MFU noise).
+      * ``attrib_every=N`` (default ``HOROVOD_PERF_ATTRIB_EVERY``, 0 =
+        off) wraps every Nth step in a ``jax.profiler.trace`` capture
+        and publishes ``hvd_step_device_busy_frac``, per-class
+        ``hvd_step_breakdown_ms`` / ``hvd_step_breakdown_drift`` (EMA
+        -relative, hvd_top's "top regressing class"), and the
+        exposed/hidden-comm overlap gauges. The first capture happens
+        at step N, never step 1 — step 1 is compile. Capture failures
+        emit a ``perf_attrib_error`` event and never break the step;
+        the steady-state overhead is bench-gated ≤2%
+        (``HVD_BENCH_PERF``).
     """
     reg = hvd_metrics.get_registry()
     if not reg.enabled:
@@ -60,10 +80,93 @@ def instrument_step(step_fn, tokens_per_step=None, name="train"):
         "Throughput of the most recent step (tokens_per_step / step "
         "seconds).", labels=("loop",))
 
+    if attrib_every is None:
+        attrib_every = env_int("PERF_ATTRIB_EVERY", 0)
+    flops_per_step = ((flops_per_token or 0) * (tokens_per_step or 0)) or None
+    if flops_per_step and spec is None:
+        from .utils import costmodel
+        try:
+            spec = costmodel.chip_spec(jax.devices()[0])
+        # hvdlint: disable=HVD006(best-effort chip detection; no spec just means no MFU gauge)
+        except Exception:
+            spec = None
+        if spec is not None and spec.kind == "cpu":
+            spec = None  # placeholder peak → MFU would be noise
+    mfu = reg.gauge(
+        "hvd_mfu", "Model FLOPs utilization of the most recent step "
+        "(flops_per_step / peak / step seconds).",
+        labels=("loop",)) if flops_per_step and spec else None
+    if attrib_every:
+        busy = reg.gauge(
+            "hvd_step_device_busy_frac",
+            "Device-busy fraction of the last attributed step "
+            "(device-op time / wall).", labels=("loop",))
+        breakdown = reg.gauge(
+            "hvd_step_breakdown_ms",
+            "Per-op-class device ms of the last attributed step.",
+            labels=("loop", "op_class"))
+        drift = reg.gauge(
+            "hvd_step_breakdown_drift",
+            "Per-op-class ms drift of the last attributed step vs its "
+            "running mean (relative; +0.1 = 10% slower than usual).",
+            labels=("loop", "op_class"))
+        exposed = reg.gauge(
+            "hvd_step_exposed_comm_ms",
+            "Collective ms NOT hidden under compute in the last "
+            "attributed step.", labels=("loop",))
+        hidden = reg.gauge(
+            "hvd_step_hidden_comm_ms",
+            "Collective ms overlapped with compute in the last "
+            "attributed step.", labels=("loop",))
+        ovl_frac = reg.gauge(
+            "hvd_step_overlap_frac",
+            "hidden / (hidden + exposed) collective ms of the last "
+            "attributed step.", labels=("loop",))
+    ema = {}  # op_class -> running-mean ms, for the drift gauge
+    counter = [0]
+
+    def _attribute(pdir, dt):
+        import shutil
+
+        from .utils import profiling
+        try:
+            dec = profiling.profile_decomposition(
+                pdir, wall_ms=dt * 1e3, steps=1)
+        finally:
+            shutil.rmtree(pdir, ignore_errors=True)
+        if dec.get("device_busy_frac") is not None:
+            busy.labels(loop=name).set(dec["device_busy_frac"])
+        for c in dec["classes"]:
+            cls, ms = c["class"], c["ms_per_step"]
+            breakdown.labels(loop=name, op_class=cls).set(ms)
+            prev = ema.get(cls)
+            if prev:
+                drift.labels(loop=name, op_class=cls).set(
+                    round(ms / prev - 1.0, 4))
+            ema[cls] = ms if prev is None else 0.8 * prev + 0.2 * ms
+        ov = dec.get("overlap")
+        if ov:
+            exposed.labels(loop=name).set(ov["exposed_comm_ms"])
+            hidden.labels(loop=name).set(ov["hidden_comm_ms"])
+            if ov["overlap_frac"] is not None:
+                ovl_frac.labels(loop=name).set(ov["overlap_frac"])
+
     tracer = hvd_tracing.get_tracer()
 
     @functools.wraps(step_fn)
     def wrapped(*args, **kwargs):
+        counter[0] += 1
+        capture = attrib_every and counter[0] % attrib_every == 0 \
+            and counter[0] > 1
+        pdir = None
+        if capture:
+            import tempfile
+            try:
+                pdir = tempfile.mkdtemp(prefix="hvd-perf-attrib-")
+                jax.profiler.start_trace(pdir)
+            except Exception:
+                reg.event("perf_attrib_error", phase="start")
+                pdir = None
         t0 = time.perf_counter()
         # step span: the root every per-tensor span of this step hangs
         # under in the postmortem timeline (stage="step", one per call)
@@ -72,10 +175,22 @@ def instrument_step(step_fn, tokens_per_step=None, name="train"):
             jax.block_until_ready(out)
             dt = time.perf_counter() - t0
             span.annotate(seconds=dt)
+        if pdir is not None:
+            try:
+                jax.profiler.stop_trace()
+                _attribute(pdir, dt)
+            except Exception as e:
+                import shutil
+                shutil.rmtree(pdir, ignore_errors=True)
+                reg.event("perf_attrib_error", phase="attribute",
+                          error=type(e).__name__)
         step_s.labels(loop=name).observe(dt)
         steps.labels(loop=name).inc()
         if tokens_per_step and dt > 0:
             tps.labels(loop=name).set(tokens_per_step / dt)
+        if mfu is not None and dt > 0:
+            mfu.labels(loop=name).set(
+                flops_per_step / (spec.peak_flops * dt))
         return out
 
     return wrapped

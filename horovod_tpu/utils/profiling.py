@@ -18,6 +18,16 @@ Works on the `*.trace.json.gz` files XLA writes under
 ``<dir>/plugins/profile/<ts>/``; host-side Python spans (``$``-prefixed)
 and jit dispatch wrappers are excluded so the durations are device-op
 time, not wall clock.
+
+Beyond the per-op sums, ``summarize_trace`` retains every device op's
+begin/end interval with its lane (the trace's pid/tid pair — on TPU one
+lane per core stream, collectives often on their own async stream).
+``overlap_accounting`` turns those into the comm/compute overlap
+numbers (exposed vs hidden collective time, per-lane busy fractions)
+that ``profile_decomposition`` embeds and the pod-scale overlap work is
+judged against — a collective summed lane-blind is indistinguishable
+from one on the critical path; a collective *interval* either is or is
+not covered by concurrent compute.
 """
 
 import collections
@@ -43,9 +53,25 @@ class OpRow:
                 f"{self.name[:40]:40s}{extra}")
 
 
+class OpEvent:
+    """One device-op occurrence: name + lane + [start, end) in ms."""
+
+    __slots__ = ("name", "lane", "start_ms", "end_ms")
+
+    def __init__(self, name, lane, start_ms, end_ms):
+        self.name = name
+        self.lane = lane
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+
+
 class TraceSummary:
-    def __init__(self, rows):
+    def __init__(self, rows, events=None, lane_names=None):
         self.rows = sorted(rows, key=lambda r: -r.total_ms)
+        # per-occurrence intervals (OpEvent), lane-keyed by "pid/tid";
+        # empty for summaries built from rows alone (pre-overlap callers)
+        self.events = events or []
+        self.lane_names = lane_names or {}
 
     @property
     def total_ms(self):
@@ -97,7 +123,13 @@ def summarize_trace(path):
     total = collections.Counter()
     count = collections.Counter()
     long_names = {}
+    op_events = []
+    lane_names = {}
     for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lane = f"{e.get('pid', 0)}/{e.get('tid', 0)}"
+            lane_names[lane] = (e.get("args") or {}).get("name", "")
+            continue
         if e.get("ph") != "X" or "dur" not in e:
             continue
         name = e.get("name", "")
@@ -109,10 +141,14 @@ def summarize_trace(path):
             args = e.get("args") or {}
             long_names[name] = (args.get("long_name") or
                                 args.get("hlo_op") or "")
+        ts = e.get("ts", 0)
+        op_events.append(OpEvent(
+            name, f"{e.get('pid', 0)}/{e.get('tid', 0)}",
+            ts / 1e3, (ts + e["dur"]) / 1e3))
     rows = [OpRow(n, n.split(".")[0], total[n] / 1e3, count[n],
                   long_names.get(n, ""))
             for n in total]
-    return TraceSummary(rows)
+    return TraceSummary(rows, events=op_events, lane_names=lane_names)
 
 
 # Op classes for profile_decomposition, first match wins (checked against
@@ -133,6 +169,10 @@ _OP_CLASSES = (
     ("fusion", ("fusion", "loop_", "input_", "output_")),
 )
 
+# the classes overlap_accounting treats as communication; everything
+# else that is a device op counts as compute cover
+_COMM_CLASSES = frozenset(("collective",))
+
 
 def classify_op(row, classes=_OP_CLASSES):
     hay = (row.name + " " + (row.long_name or "")).lower()
@@ -142,13 +182,103 @@ def classify_op(row, classes=_OP_CLASSES):
     return "other"
 
 
+def _merge_intervals(intervals):
+    """Union of [start, end) intervals as a sorted disjoint list."""
+    out = []
+    for s, e in sorted(intervals):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1][1] = e
+        else:
+            out.append([s, e])
+    return out
+
+
+def _span_ms(merged):
+    return sum(e - s for s, e in merged)
+
+
+def _intersect_ms(a, b):
+    """Total overlap between two DISJOINT SORTED interval lists."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_accounting(summary, classes=_OP_CLASSES, steps=1,
+                       comm_classes=_COMM_CLASSES):
+    """Comm/compute overlap from a lane-aware capture: how much
+    collective time was HIDDEN under concurrent compute (any compute
+    lane busy at the same instant) vs EXPOSED on the critical path, and
+    how busy each device lane was over the captured span.
+
+    These are the exact numbers a comm-overlap optimization must move:
+    bucketed allreduce launched during backward turns exposed_comm_ms
+    into hidden_comm_ms; the summed per-class ms in the decomposition
+    cannot tell the difference. Returns a plain dict (all ms divided by
+    ``steps`` so it reads per step); None when the summary carries no
+    intervals (a rows-only summary from an old caller).
+    """
+    summary = summary if isinstance(summary, TraceSummary) else \
+        summarize_trace(summary)
+    if not summary.events:
+        return None
+    class_of = {r.name: classify_op(r, classes) for r in summary.rows}
+    comm_iv, compute_iv = [], []
+    by_lane = collections.defaultdict(list)
+    for ev in summary.events:
+        iv = (ev.start_ms, ev.end_ms)
+        (comm_iv if class_of.get(ev.name) in comm_classes
+         else compute_iv).append(iv)
+        by_lane[ev.lane].append(iv)
+    comm = _merge_intervals(comm_iv)
+    compute = _merge_intervals(compute_iv)
+    comm_ms = _span_ms(comm)
+    hidden = _intersect_ms(comm, compute)
+    exposed = comm_ms - hidden
+    span_start = min(s for s, _ in (comm + compute))
+    span_end = max(e for _, e in (comm + compute))
+    span = span_end - span_start
+    lanes = []
+    for lane in sorted(by_lane):
+        busy = _span_ms(_merge_intervals(by_lane[lane]))
+        lanes.append({
+            "lane": lane,
+            "name": summary.lane_names.get(lane, ""),
+            "busy_ms_per_step": round(busy / steps, 3),
+            "busy_frac": round(busy / span, 4) if span else None,
+        })
+    return {
+        "comm_ms_per_step": round(comm_ms / steps, 3),
+        "compute_ms_per_step": round(_span_ms(compute) / steps, 3),
+        "hidden_comm_ms": round(hidden / steps, 3),
+        "exposed_comm_ms": round(exposed / steps, 3),
+        "overlap_frac": round(hidden / comm_ms, 4) if comm_ms else None,
+        "span_ms_per_step": round(span / steps, 3),
+        "lanes": lanes,
+    }
+
+
 def profile_decomposition(trace, wall_ms=None, steps=1,
                           classes=_OP_CLASSES, top_per_class=3):
     """Account for every millisecond of a step: group a capture's
     device-op time into op classes (flash kernels, matmuls, collectives,
     copies, fusions, other) and, when the wall time of the traced region
     is known, report the residual — wall minus device-busy, i.e. host
-    dispatch + inter-op gaps, the part no per-op row can show.
+    dispatch + inter-op gaps, the part no per-op row can show. When the
+    capture carries per-lane intervals the ``overlap`` block reports
+    exposed vs hidden collective time (see ``overlap_accounting``).
 
     ``trace`` is a profiler dir / trace file / TraceSummary; ``wall_ms``
     the traced region's wall-clock PER STEP; ``steps`` how many steps the
@@ -181,11 +311,18 @@ def profile_decomposition(trace, wall_ms=None, steps=1,
         })
     out = {"device_ms_per_step": round(device_ms, 3),
            "classes": per_class, "steps": steps}
-    if wall_ms is not None:
+    if wall_ms:  # a zero/None wall is unusable: no residual, no frac —
+        # a 0 here used to emit a nonsense residual of -device_ms
         out["wall_ms_per_step"] = round(wall_ms, 3)
         out["residual_ms_per_step"] = round(wall_ms - device_ms, 3)
-        out["device_busy_frac"] = round(device_ms / wall_ms, 4) \
-            if wall_ms else None
+        out["device_busy_frac"] = round(device_ms / wall_ms, 4)
+    elif wall_ms is not None:
+        out["wall_ms_per_step"] = 0.0
+        out["residual_ms_per_step"] = None
+        out["device_busy_frac"] = None
+    overlap = overlap_accounting(summary, classes=classes, steps=steps)
+    if overlap is not None:
+        out["overlap"] = overlap
     return out
 
 
@@ -197,6 +334,8 @@ def main(argv=None):
     p.add_argument("-n", type=int, default=20, help="rows to print")
     p.add_argument("--decompose", action="store_true",
                    help="print the op-class decomposition instead")
+    p.add_argument("--overlap", action="store_true",
+                   help="print the comm/compute overlap accounting")
     p.add_argument("--wall-ms", type=float, default=None,
                    help="wall ms/step of the traced region (residual row)")
     p.add_argument("--steps", type=int, default=1,
@@ -207,6 +346,10 @@ def main(argv=None):
         dec = profile_decomposition(summary, wall_ms=args.wall_ms,
                                     steps=args.steps)
         print(json.dumps(dec, indent=2))
+        return
+    if args.overlap:
+        print(json.dumps(overlap_accounting(summary, steps=args.steps),
+                         indent=2))
         return
     print(f"device-op total: {summary.total_ms:.1f} ms "
           f"({len(summary.rows)} distinct ops)")

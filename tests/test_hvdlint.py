@@ -784,6 +784,115 @@ def test_hvd012_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD013 — ad-hoc step timers in hot-path modules
+# ---------------------------------------------------------------------------
+
+def test_hvd013_triggers_on_perf_counter_in_hot_path(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+        import time
+
+        def step(fn, x):
+            t0 = time.perf_counter()
+            y = fn(x)
+            dt = time.perf_counter_ns() - t0
+            return y, dt
+        """)
+    assert [f.rule for f in live(found)] == ["HVD013"] * 2
+
+
+def test_hvd013_triggers_on_from_import_alias(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+        from time import perf_counter as pc
+
+        def step(fn, x):
+            t0 = pc()
+            return fn(x), pc() - t0
+        """)
+    assert [f.rule for f in live(found)] == ["HVD013"] * 2
+
+
+def test_hvd013_triggers_in_real_ops_path(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "ops"
+    mod.mkdir(parents=True)
+    f = mod / "fusion.py"
+    f.write_text(textwrap.dedent("""\
+        import time
+
+        def flush(buckets):
+            t0 = time.perf_counter()
+            return buckets, t0
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD013"]
+
+
+def test_hvd013_monotonic_refs_and_cold_paths_are_clean(tmp_path):
+    # time.monotonic is the shared clock's base and the wire-timeout
+    # primitive; a bare attribute reference (clock=time.monotonic) is
+    # not a timing read; and outside the hot-path scope raw timers are
+    # someone else's business
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+        import time
+
+        def deadline(timeout_s):
+            return time.monotonic() + timeout_s
+
+        def make_engine():
+            return dict(clock=time.monotonic, now=time.perf_counter)
+        """)
+    assert live(found) == []
+    found = lint_source(tmp_path, """\
+        import time
+
+        def bench_once(fn):
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+        """)
+    assert live(found) == []
+
+
+def test_hvd013_instrument_step_is_sanctioned(tmp_path):
+    mod = tmp_path / "horovod_tpu"
+    mod.mkdir(parents=True)
+    f = mod / "trainer.py"
+    f.write_text(textwrap.dedent("""\
+        import time
+
+        def instrument_step(step_fn):
+            def wrapped(*a):
+                t0 = time.perf_counter()
+                out = step_fn(*a)
+                return out, time.perf_counter() - t0
+            return wrapped
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd013_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+        import time
+
+        def flush(buckets):
+            # hvdlint: disable=HVD013(flush duration feeding the hvd_fusion_flush_seconds histogram)
+            t0 = time.perf_counter()
+            return buckets, t0
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD013"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -843,7 +952,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 13)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 14)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

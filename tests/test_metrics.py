@@ -418,6 +418,75 @@ class TestSatelliteInstrumentation:
         (tps,) = m["hvd_tokens_per_second"]["values"]
         assert 0 < tps["value"] <= 1024 / 0.01
 
+    def test_instrument_step_mfu_gauge(self, reg):
+        from horovod_tpu import trainer
+        from horovod_tpu.utils import costmodel
+        spec = costmodel.ChipSpec("test", 1e9, 1e9, 1e9)
+
+        def step(x):
+            time.sleep(0.01)
+            return x
+
+        wrapped = trainer.instrument_step(
+            step, tokens_per_step=1000, name="unit",
+            flops_per_token=1e6, spec=spec)
+        wrapped(1)
+        m = reg.snapshot()["metrics"]
+        (mfu,) = m["hvd_mfu"]["values"]
+        assert mfu["labels"] == {"loop": "unit"}
+        # flops_per_step=1e9 at peak 1e9 → mfu = 1/dt seconds⁻¹·s;
+        # dt ≥ 10ms → mfu ≤ 100, > 0
+        assert 0 < mfu["value"] <= 100
+
+    def test_instrument_step_no_mfu_without_spec_on_cpu(self, reg):
+        from horovod_tpu import trainer
+        wrapped = trainer.instrument_step(
+            lambda x: x, tokens_per_step=10, name="unit",
+            flops_per_token=100)  # spec auto-detect → cpu → no gauge
+        wrapped(1)
+        assert "hvd_mfu" not in reg.snapshot()["metrics"]
+
+    def test_instrument_step_periodic_attribution(self, reg):
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu import trainer
+        f = jax.jit(lambda x: jnp.dot(x, x).sum())
+        x = jnp.ones((64, 64))
+        f(x).block_until_ready()  # compile outside the wrapper
+
+        def step(x):
+            out = f(x)
+            out.block_until_ready()
+            return out
+
+        wrapped = trainer.instrument_step(step, name="unit",
+                                          attrib_every=2)
+        for _ in range(5):  # captures at steps 2 and 4
+            wrapped(x)
+        assert not [e for e in reg.events()
+                    if e["event"] == "perf_attrib_error"]
+        m = reg.snapshot()["metrics"]
+        (busy,) = m["hvd_step_device_busy_frac"]["values"]
+        assert busy["labels"] == {"loop": "unit"}
+        assert busy["value"] >= 0
+        classes = {v["labels"]["op_class"]
+                   for v in m["hvd_step_breakdown_ms"]["values"]}
+        assert "matmul" in classes
+        # second capture has an EMA to drift against
+        assert m["hvd_step_breakdown_drift"]["values"]
+        assert m["hvd_step_exposed_comm_ms"]["values"]
+        assert m["hvd_step_hidden_comm_ms"]["values"]
+
+    def test_instrument_step_attrib_off_by_default(self, reg):
+        from horovod_tpu import trainer
+        wrapped = trainer.instrument_step(lambda x: x, name="unit")
+        for _ in range(3):
+            wrapped(1)
+        m = reg.snapshot()["metrics"]
+        assert "hvd_step_breakdown_ms" not in m
+        assert "hvd_step_device_busy_frac" not in m
+
     def test_instrument_step_disabled_is_passthrough(self):
         hvd_metrics.reset(enabled=False)
         try:

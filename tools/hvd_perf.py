@@ -1,0 +1,325 @@
+"""hvd_perf — the bench trajectory, read back with teeth.
+
+The repo checks in one ``BENCH_r*.json`` per round, but until now the
+trajectory was compared by eyeball: nothing would notice the LM
+headline sliding 119k → 110k tokens/s across two PRs. This tool ingests
+the checked-in history (plus, optionally, a fresh run's output),
+computes per-leg deltas with noise bands, and exits nonzero when the
+NEWEST run regresses beyond threshold — wired into ci/run_tests.sh so
+the ledger gates instead of decorating.
+
+    python tools/hvd_perf.py --report BENCH_r*.json      # trajectory
+    python tools/hvd_perf.py --check  BENCH_r*.json      # CI gate
+    python tools/hvd_perf.py --check  BENCH_r*.json fresh_run.json
+
+Input formats (both accepted per file): the checked-in wrapper
+``{"n": ..., "cmd": ..., "parsed": {...}}`` or a raw bench JSON line /
+file whose LAST JSON line is the bench dict (i.e. ``bench.py``'s stdout
+redirected to a file works unmodified).
+
+Each leg carries *context* fields (model, seq_len, batch_per_chip);
+a leg is only compared against the most recent earlier run where the
+leg exists AND the context matches — the r03→r04 flagship batch change
+(8→16) doubles ms/step for config reasons, and a ledger that flagged
+that as a 2× regression would be noise, not a gate. Noise bands come
+from the bench's own ``*_pm`` half-ranges when present: the effective
+threshold is ``max(--threshold, noise_pct)`` so a delta inside the
+measured run-to-run spread never trips.
+
+Runs are ordered by provenance timestamp when stamped (bench.py ≥ r06
+embeds ``provenance``), else by the wrapper's round number, else by
+filename — so mixed old/new histories still sort.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+DEFAULT_THRESHOLD_PCT = float(os.environ.get(
+    "HVD_PERF_THRESHOLD_PCT", "5.0"))
+
+
+class Leg:
+    """One gated series: where to find the value in the parsed bench
+    dict, whether higher is better, where its ± half-range and its
+    config-context fields live."""
+
+    def __init__(self, key, path, higher_better=True, pm_path=None,
+                 context_paths=()):
+        self.key = key
+        self.path = path
+        self.higher_better = higher_better
+        self.pm_path = pm_path
+        self.context_paths = context_paths
+
+
+def _dig(d, path):
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return None
+        d = d[p]
+    return d
+
+
+_LM_CTX = (("transformer_lm", "model"), ("transformer_lm", "seq_len"),
+           ("transformer_lm", "batch_per_chip"))
+
+LEGS = (
+    Leg("resnet50_img_per_sec_per_chip", ("value",),
+        pm_path=("value_pm",), context_paths=(("metric",),)),
+    Leg("lm_tokens_per_sec_per_chip",
+        ("transformer_lm", "tokens_per_sec_per_chip"),
+        context_paths=_LM_CTX),
+    Leg("lm_mfu", ("transformer_lm", "mfu"), context_paths=_LM_CTX),
+    Leg("lm_ms_per_step", ("transformer_lm", "ms_per_step"),
+        higher_better=False, pm_path=("transformer_lm", "ms_per_step_pm"),
+        context_paths=_LM_CTX),
+    Leg("serve_speedup", ("serve", "speedup_tokens_per_step")),
+    Leg("ckpt_overhead_pct", ("ckpt", "overhead_pct"),
+        higher_better=False),
+)
+
+
+class Run:
+    def __init__(self, path, parsed, order_key):
+        self.path = path
+        self.parsed = parsed
+        self.order_key = order_key
+
+    @property
+    def label(self):
+        prov = self.parsed.get("provenance") or {}
+        return prov.get("label") or os.path.basename(self.path)
+
+
+def _last_json_line(text):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def load_run(path, seq):
+    """One Run from a wrapper file, raw bench JSON, or captured stdout.
+    ``seq`` breaks order ties for runs without timestamps/round
+    numbers (the argv position — histories sort by filename anyway)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = _last_json_line(text)
+    if isinstance(doc, dict) and "parsed" in doc:
+        parsed, rnd = doc["parsed"], doc.get("n")
+    elif isinstance(doc, dict) and ("metric" in doc or
+                                    "transformer_lm" in doc):
+        parsed, rnd = doc, None
+    else:
+        raise ValueError(f"{path}: neither a BENCH_r wrapper nor a "
+                         "bench JSON line")
+    if not isinstance(parsed, dict):
+        raise ValueError(f"{path}: 'parsed' is not an object")
+    prov = parsed.get("provenance") or {}
+    # three-tier ordering: stamped time > round number > argv position
+    ts = prov.get("unix_ms")
+    order = (0, ts) if ts is not None else \
+        (1, rnd) if rnd is not None else (2, seq)
+    return Run(path, parsed, order)
+
+
+def load_history(paths):
+    runs = [load_run(p, i) for i, p in enumerate(paths)]
+    # mixed tiers: stamped runs are assumed newer than round-numbered
+    # ones which are newer than unordered ones — but within the real
+    # history all three keys increase monotonically anyway, so a plain
+    # sort on (tier-reversed) keys keeps old-before-new
+    tier_rank = {0: 2, 1: 1, 2: 0}  # unstamped history first
+    runs.sort(key=lambda r: (tier_rank[r.order_key[0]], r.order_key[1]))
+    return runs
+
+
+def _context(leg, parsed):
+    return tuple(_dig(parsed, p) for p in leg.context_paths)
+
+
+def _worse_pct(leg, old, new):
+    """How much worse the new value is, in percent (negative =
+    improved)."""
+    if old == 0:
+        return 0.0
+    d = (new - old) / abs(old) * 100.0
+    return -d if leg.higher_better else d
+
+
+def compare(runs, threshold_pct):
+    """Deltas for the NEWEST run: each leg against the most recent
+    earlier run with the leg present and matching context. Returns
+    (rows, regressions) where rows power the report."""
+    if not runs:
+        return [], []
+    latest = runs[-1]
+    rows, regressions = [], []
+    for leg in LEGS:
+        new = _dig(latest.parsed, leg.path)
+        if new is None:
+            continue
+        row = {"leg": leg.key, "value": new, "baseline": None,
+               "baseline_run": None, "delta_pct": None,
+               "worse_pct": None, "noise_pct": None,
+               "threshold_pct": threshold_pct, "status": "new"}
+        ctx = _context(leg, latest.parsed)
+        for prev in reversed(runs[:-1]):
+            old = _dig(prev.parsed, leg.path)
+            if old is None:
+                continue
+            if _context(leg, prev.parsed) != ctx:
+                row["status"] = "config-changed"
+                row["baseline_run"] = prev.label
+                break
+            worse = _worse_pct(leg, old, new)
+            noise = 0.0
+            if leg.pm_path and old:
+                pm_old = _dig(prev.parsed, leg.pm_path) or 0.0
+                pm_new = _dig(latest.parsed, leg.pm_path) or 0.0
+                noise = (pm_old + pm_new) / abs(old) * 100.0
+            eff = max(threshold_pct, noise)
+            row.update({
+                "baseline": old, "baseline_run": prev.label,
+                "delta_pct": round((new - old) / abs(old) * 100.0, 2)
+                if old else None,
+                "worse_pct": round(worse, 2),
+                "noise_pct": round(noise, 2),
+                "threshold_pct": round(eff, 2),
+                "status": "regressed" if worse > eff else "ok",
+            })
+            if worse > eff:
+                regressions.append(row)
+            break
+        rows.append(row)
+    return rows, regressions
+
+
+def trajectory(runs):
+    """Full history per leg (the --report body): every run's value with
+    its delta vs the previous comparable run."""
+    out = {}
+    for leg in LEGS:
+        series = []
+        prev_val, prev_ctx = None, None
+        for run in runs:
+            v = _dig(run.parsed, leg.path)
+            if v is None:
+                continue
+            ctx = _context(leg, run.parsed)
+            entry = {"run": run.label, "value": v}
+            if prev_val is not None:
+                if ctx != prev_ctx:
+                    entry["note"] = "config-changed"
+                elif prev_val:
+                    entry["delta_pct"] = round(
+                        (v - prev_val) / abs(prev_val) * 100.0, 2)
+            series.append(entry)
+            prev_val, prev_ctx = v, ctx
+        if series:
+            out[leg.key] = series
+    return out
+
+
+def render_report(runs, rows, traj):
+    lines = [f"hvd_perf: {len(runs)} runs "
+             f"({runs[0].label} .. {runs[-1].label})", ""]
+    width = max((len(k) for k in traj), default=10)
+    for key, series in traj.items():
+        pieces = []
+        for e in series:
+            p = f"{e['value']:g}"
+            if "delta_pct" in e:
+                p += f" ({e['delta_pct']:+.1f}%)"
+            if e.get("note"):
+                p += f" [{e['note']}]"
+            pieces.append(p)
+        lines.append(f"  {key:<{width}}  " + "  ->  ".join(pieces))
+    lines.append("")
+    lines.append(f"latest run: {runs[-1].label}")
+    for row in rows:
+        status = row["status"]
+        mark = {"ok": "ok", "regressed": "REGRESSED",
+                "new": "new leg", "config-changed": "config changed"}
+        detail = ""
+        if row["worse_pct"] is not None:
+            detail = (f"  {row['worse_pct']:+.2f}% worse "
+                      f"(threshold {row['threshold_pct']:.2f}%, "
+                      f"noise {row['noise_pct']:.2f}%)")
+        lines.append(f"  {row['leg']:<{width}}  {mark[status]:<14}"
+                     f"{detail}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hvd_perf",
+        description="Bench-trajectory ledger and perf-regression gate "
+                    "over BENCH_r*.json history files.")
+    ap.add_argument("files", nargs="+",
+                    help="history files oldest-to-newest (globs ok); "
+                         "the newest is the run under judgment")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if the newest run regresses any leg "
+                         "beyond threshold")
+    ap.add_argument("--report", action="store_true",
+                    help="print the human trajectory report")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine report (trajectory + "
+                         "latest-run rows)")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD_PCT,
+                    help="regression threshold in percent (default "
+                         "%(default)s, env HVD_PERF_THRESHOLD_PCT); "
+                         "per-leg noise bands can only raise it")
+    args = ap.parse_args(argv)
+
+    paths = []
+    for pat in args.files:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    try:
+        runs = load_history(paths)
+    except (OSError, ValueError) as e:
+        print(f"hvd_perf: {e}", file=sys.stderr)
+        return 2
+    if not runs:
+        print("hvd_perf: no runs loaded", file=sys.stderr)
+        return 2
+    rows, regressions = compare(runs, args.threshold)
+    traj = trajectory(runs)
+    if args.json:
+        print(json.dumps({"runs": [r.label for r in runs],
+                          "trajectory": traj, "latest": rows,
+                          "regressions": [r["leg"] for r in regressions]},
+                         indent=2))
+    if args.report or not (args.json or args.check):
+        print(render_report(runs, rows, traj))
+    if args.check:
+        if regressions:
+            for row in regressions:
+                print(f"hvd_perf: REGRESSION {row['leg']}: "
+                      f"{row['baseline']:g} -> {row['value']:g} "
+                      f"({row['worse_pct']:+.2f}% worse, threshold "
+                      f"{row['threshold_pct']:.2f}%) vs "
+                      f"{row['baseline_run']}", file=sys.stderr)
+            return 1
+        if not args.report and not args.json:
+            print(f"hvd_perf: ok — {runs[-1].label} within "
+                  f"{args.threshold:g}% of history on "
+                  f"{sum(1 for r in rows if r['status'] == 'ok')} legs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

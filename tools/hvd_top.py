@@ -329,6 +329,38 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         lines.append(f"    steps {scount:>8,}   mean {_fmt_s(ssum / scount):>8}"
                      f"   p50 {_fmt_s(sp50):>8}   tokens/s {tps:,.0f}")
 
+    # perf attribution: the in-training roofline story — live MFU,
+    # device occupancy, exposed-vs-hidden comm, and whichever op class
+    # is drifting hardest off its own EMA (trainer.instrument_step
+    # with HOROVOD_PERF_ATTRIB_EVERY; docs/profiling.md)
+    mfu = _total(snap, "hvd_mfu")
+    busy = _total(snap, "hvd_step_device_busy_frac")
+    breakdown = _by_label(snap, "hvd_step_breakdown_ms", "op_class")
+    if mfu or busy or breakdown:
+        lines.append(c(BOLD, "  perf attribution"))
+        ovf = _total(snap, "hvd_step_overlap_frac")
+        lines.append(f"    mfu {100.0 * mfu:>6.1f}%   "
+                     f"device busy {100.0 * busy:>5.1f}%   "
+                     f"comm overlap {100.0 * ovf:>5.1f}%")
+        exp = _total(snap, "hvd_step_exposed_comm_ms")
+        hid = _total(snap, "hvd_step_hidden_comm_ms")
+        comm_line = (f"    comm          exposed {exp:>8.2f}ms   "
+                     f"hidden {hid:>8.2f}ms")
+        # exposed comm is the lost wall-clock; hidden comm is free
+        lines.append(c(YELLOW, comm_line)
+                     if exp > max(1.0, 2.0 * hid) else comm_line)
+        top = sorted(breakdown.items(), key=lambda kv: -kv[1])[:4]
+        if top:
+            lines.append("    breakdown     " + "  ".join(
+                f"{k}={v:.1f}ms" for k, v in top))
+        drift = _by_label(snap, "hvd_step_breakdown_drift", "op_class")
+        if drift:
+            worst, wd = max(drift.items(), key=lambda kv: kv[1])
+            if wd > 0.0:
+                dline = (f"    top drift     {worst} "
+                         f"{100.0 * wd:+.1f}% vs its EMA")
+                lines.append(c(YELLOW, dline) if wd > 0.1 else dline)
+
     # checkpoint plane: durability at a glance — how stale is the last
     # commit, and is the async writer keeping up (drops) or corrupting
     # (restore outcomes). (horovod_tpu/utils/checkpoint.py;
@@ -526,6 +558,26 @@ def canned_snapshot():
     reg.gauge("hvd_compression_norm_delta", "g",
               labels=("tensor", "compressor")).labels(
         tensor="grad/embed", compressor="fp16").set(3.1e-4)
+    reg.gauge("hvd_mfu", "g", labels=("loop",)).labels(loop="train").set(
+        0.421)
+    reg.gauge("hvd_step_device_busy_frac", "g",
+              labels=("loop",)).labels(loop="train").set(0.873)
+    bd = reg.gauge("hvd_step_breakdown_ms", "g",
+                   labels=("loop", "op_class"))
+    dr = reg.gauge("hvd_step_breakdown_drift", "g",
+                   labels=("loop", "op_class"))
+    for op_class, ms, d in (("matmul", 61.0, 0.01), ("flash_fwd", 12.3,
+                                                     -0.02),
+                            ("collective", 9.7, 0.124), ("copy", 2.2,
+                                                         0.0)):
+        bd.labels(loop="train", op_class=op_class).set(ms)
+        dr.labels(loop="train", op_class=op_class).set(d)
+    reg.gauge("hvd_step_exposed_comm_ms", "g",
+              labels=("loop",)).labels(loop="train").set(3.4)
+    reg.gauge("hvd_step_hidden_comm_ms", "g",
+              labels=("loop",)).labels(loop="train").set(6.3)
+    reg.gauge("hvd_step_overlap_frac", "g",
+              labels=("loop",)).labels(loop="train").set(0.65)
     cs = reg.counter("hvd_ckpt_saves_total", "c", labels=("kind",))
     cs.labels(kind="async").inc(41)
     cs.labels(kind="emergency").inc(1)

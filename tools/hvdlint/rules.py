@@ -1031,6 +1031,68 @@ def check_adhoc_serialization(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD013 — ad-hoc step timing in hot-path modules
+# ---------------------------------------------------------------------------
+
+# the planes where a stray timer means a parallel, unpublished timing
+# story: collective ops, the serving loop, and the trainer itself
+_HOT_PATH_DIRS = ("horovod_tpu/ops/", "horovod_tpu/serving/")
+_HOT_PATH_SUFFIXES = ("horovod_tpu/trainer.py",)
+_STEP_TIMER_CALLS = {"perf_counter", "perf_counter_ns"}
+
+
+def _inside_instrument_step(node):
+    """True when the call sits lexically inside trainer.instrument_step
+    (including its nested ``wrapped`` closure) — the ONE sanctioned
+    step timer."""
+    cur = getattr(node, "hvdlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                cur.name == "instrument_step":
+            return True
+        cur = getattr(cur, "hvdlint_parent", None)
+    return False
+
+
+def check_adhoc_step_timer(ctx, shared):
+    if not ("hot_path" in ctx.roles or
+            any(d in ctx.relpath for d in _HOT_PATH_DIRS) or
+            ctx.relpath.endswith(_HOT_PATH_SUFFIXES)):
+        return
+    # `from time import perf_counter` aliases
+    aliases = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _STEP_TIMER_CALLS:
+                    aliases.add(a.asname or a.name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        hit = ((chain is not None and len(chain) == 2 and
+                chain[0] == "time" and chain[1] in _STEP_TIMER_CALLS) or
+               (isinstance(node.func, ast.Name) and
+                node.func.id in aliases))
+        if not hit or _inside_instrument_step(node):
+            continue
+        yield Finding(
+            "HVD013", ctx.relpath, node.lineno, node.col_offset,
+            "ad-hoc step timer in a hot-path module: a raw "
+            "perf_counter() here starts a parallel timing story that "
+            "never reaches the metrics registry, the perf-attribution "
+            "gauges, or the bench ledger — the numbers it produces get "
+            "compared against instrumented ones and the discrepancy "
+            "burns a debugging day. Step walls belong to "
+            "trainer.instrument_step (hvd_step_seconds + the attribution "
+            "gauges); sub-step durations belong to utils/profiling "
+            "captures; timestamps belong to "
+            "utils.metrics.shared_clock(). Keep a local timer only with "
+            "a disable reason naming what it measures and why no shared "
+            "instrument fits.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1348,5 +1410,41 @@ loop (async, sharded, preemption-safe); ``checkpoint.save(path,
 tree)`` for one-shot dumps. Both give you the commit protocol for
 free.""",
             check_adhoc_serialization),
+        Rule(
+            "HVD013", "adhoc-step-timer",
+            "raw perf_counter step timing in hot-path modules",
+            """HVD013 — ad-hoc step timing in hot-path modules
+
+The perf-attribution plane gives step time exactly one front door:
+``trainer.instrument_step`` wraps the step, syncs, and publishes
+hvd_step_seconds / hvd_tokens_per_second / hvd_mfu plus (at
+HOROVOD_PERF_ATTRIB_EVERY cadence) the per-class breakdown and overlap
+gauges; ``utils/profiling`` decomposes sub-step device time from
+profiler captures; ``utils.metrics.shared_clock()`` anchors
+timestamps. Every number from those paths lands in the registry, the
+bench JSON, and the hvd_perf ledger — comparable across runs and
+ranks.
+
+A stray ``t0 = time.perf_counter()`` around a step in an op or the
+serving loop produces a second, unpublished number for the "same"
+thing — usually measuring subtly different boundaries (no device sync,
+or sync included where the instrumented number excludes it). The
+historical shape: a printf-timing experiment that ships, then disagrees
+with hvd_step_seconds by 8%, and the 8% gets chased as a perf bug when
+it is two stopwatches timing two different races.
+
+Flags ``time.perf_counter()/perf_counter_ns()`` calls (module attribute
+or from-import alias) in horovod_tpu/ops/, horovod_tpu/serving/ and
+horovod_tpu/trainer.py — except lexically inside ``instrument_step``
+itself, the sanctioned wrapper. ``time.monotonic`` is not flagged (it
+is the shared clock's own base and the wire planes' timeout primitive);
+``time.time`` is already HVD004. Fixtures opt in with ``# hvdlint:
+role=hot_path``.
+
+Fix: wrap the loop with ``trainer.instrument_step`` (it composes —
+pass ``name=`` to keep loops distinct); for durations that feed a
+histogram on the shared registry, keep the timer and add a disable
+reason saying which instrument consumes it.""",
+            check_adhoc_step_timer),
     ]
 }
