@@ -71,6 +71,17 @@ echo "--- serving plane (fast fail: scheduler invariants, KV ledger, SLO metrics
 # The 2-process replica-loss drill rides test_chaos_plane.py.
 python -m pytest tests/test_serving.py -q -m "not slow"
 
+echo "--- request-path tracing (fast fail: span lifecycle, phase decomposition, tail attribution)"
+# Request tracing (serving/tracing.py) is default-on in the serving
+# plane and is the whole p99 story: per-request phase decomposition,
+# goodput accounting, and the hvd_slo tail analyzer that names the
+# dominant phase. The suite is process-local (queue-side tests skip
+# jax entirely); the hvd_slo selftest round-trips synthetic flight
+# dumps with known-slow phases through the analyzer and asserts the
+# verdicts name them.
+python -m pytest tests/test_serve_tracing.py -q -m "not slow"
+python tools/hvd_slo.py --selftest
+
 echo "--- checkpoint plane (fast fail: commit protocol, torture matrix, reshard)"
 # Every robustness story (elastic restart, preemption, the chaos
 # drills) stands on the checkpoint plane's one promise: anything it

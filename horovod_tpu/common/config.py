@@ -329,6 +329,13 @@ ENV_REGISTRY = (
     ("HOROVOD_SERVE_SLOTS", True, "8", "serving/engine.py",
      "Device batch slots of the continuous-batching engine (the max "
      "concurrently decoding requests)."),
+    ("HOROVOD_SERVE_TRACE", True, "1", "serving/tracing.py",
+     "Set 0 to disable request-path tracing (per-request spans, phase "
+     "decomposition, goodput accounting) in the serving plane."),
+    ("HOROVOD_SERVE_TRACE_SLOW_TICK_MS", True, "250.0",
+     "serving/tracing.py",
+     "Decode ticks slower than this emit a slow_decode_tick event "
+     "into the metrics ring."),
     ("HOROVOD_STALL_CHECK_DISABLE", True, "0", "common/config.py",
      "Disable the coordinator's stalled-rank warnings."),
     ("HOROVOD_STALL_CHECK_TIME_SECONDS", True, "60.0",
@@ -433,6 +440,12 @@ ENV_REGISTRY = (
     ("HVD_BENCH_SERVE", False, None, "bench.py",
      "Set 0 to skip the serving bench leg (continuous vs static "
      "batching under Poisson load, p50/p99 TTFT)."),
+    ("HVD_BENCH_SERVE_TRACE", False, None, "bench.py",
+     "Set 0 to skip the request-tracing overhead sub-gate of the "
+     "serving bench leg (tracing on vs off <=2% wall per step)."),
+    ("HVD_SLO_PCT", False, "90", "tools/hvd_slo.py",
+     "Tail percentile the hvd_slo analyzer attributes (the slowest "
+     "(100-pct)% of completed requests form the tail)."),
     ("HVD_PERF_THRESHOLD_PCT", False, "5.0", "tools/hvd_perf.py",
      "Default regression threshold (percent) for the hvd_perf bench-"
      "trajectory gate; per-leg noise bands can only raise it."),

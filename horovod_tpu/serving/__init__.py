@@ -15,6 +15,11 @@ the same model under live traffic (docs/serving.md). The pieces:
   * engine.py    — the step loop tying it together + SLO metrics
   * replica.py   — replica-group liveness on the negotiation
                    control plane (bounded-time loss detection)
+  * tracing.py   — request-path spans + latency decomposition: every
+                   request is one trace (queue_wait/prefill/decode/
+                   requeue/scheduler_stall ms), feeding the flight
+                   recorder, hvd_serve_phase_seconds, and the
+                   tools/hvd_slo.py tail analyzer
 
 Import surface is lazy-free and light: importing the package pulls jax
 only when the engine/decode modules are touched.
@@ -23,10 +28,11 @@ only when the engine/decode modules are touched.
 from .queue import AdmissionQueue, Request, RequestResult
 from .scheduler import SlotScheduler
 from .kv_cache import BlockLedger
+from .tracing import RequestTrace
 
 __all__ = [
     "AdmissionQueue", "Request", "RequestResult", "SlotScheduler",
-    "BlockLedger", "ServeEngine", "ReplicaGroup",
+    "BlockLedger", "RequestTrace", "ServeEngine", "ReplicaGroup",
 ]
 
 

@@ -31,6 +31,7 @@ from ..common import config
 from ..common.exceptions import RanksLostError
 from ..utils import metrics as hvd_metrics
 from ..utils import tracing as hvd_tracing
+from . import tracing as serve_tracing
 from .decode import decode_step, prefill_forward
 from .kv_cache import KVCache
 from .queue import AdmissionQueue, RequestResult
@@ -132,6 +133,26 @@ class ServeEngine:
         self._m_blocks = reg.gauge(
             "hvd_serve_kv_blocks_in_use",
             "KV-cache blocks currently claimed by active slots.")
+        # SLO goodput accounting (docs/serving.md): a token only counts
+        # as goodput when its request completed within its deadline;
+        # everything else — deadline-blown, kv-exhausted, evicted — is
+        # wasted device work, labeled by why.
+        self._m_goodput = reg.counter(
+            "hvd_serve_goodput_tokens_total",
+            "Tokens (prefill + decode) of requests that completed "
+            "within their SLO deadline.")
+        self._m_wasted = reg.counter(
+            "hvd_serve_wasted_tokens_total",
+            "Tokens (prefill + decode) whose request ended without "
+            "meeting its SLO, by why the work was wasted.",
+            labels=("reason",))
+        self._m_goodput_ratio = reg.gauge(
+            "hvd_serve_goodput_ratio",
+            "goodput / (goodput + wasted) tokens over the engine's "
+            "life; 1.0 until the first wasted token.")
+        self._goodput_tokens = 0
+        self._wasted_tokens = 0
+        serve_tracing.phase_histogram(reg)
         self._gauge_interval = config.env_float(
             "SERVE_METRICS_INTERVAL_S", 1.0)
         self._last_gauge_ts = -1e30
@@ -177,7 +198,13 @@ class ServeEngine:
             self._replica.heartbeat()
         except RanksLostError as err:
             lost = tuple(int(r) for r in err.ranks)
-            self._metrics.event("serve_failover", lost_ranks=list(lost))
+            # name the in-flight requests in the event: their spans are
+            # still open, so the dump below carries them and
+            # hvd_postmortem / hvd_slo can tell whose work died here
+            inflight = sorted(st.request.request_id
+                              for st in self._active.values())
+            self._metrics.event("serve_failover", lost_ranks=list(lost),
+                                inflight=inflight)
             hvd_tracing.get_tracer().dump("serve_ranks_lost")
             replica, self._replica = self._replica, None
             replica.close()
@@ -202,12 +229,15 @@ class ServeEngine:
                     self.kv.ledger._blocks_for(final_len) >
                     self.kv.ledger.total_blocks):
                 self._m_requests.labels(outcome="failed").inc()
+                trace = serve_tracing.trace_of(req)
+                phases = trace.on_reject("too_long")
                 self._metrics.event(
                     "serve_reject", request_id=req.request_id,
-                    reason="too_long")
+                    reason="too_long", trace_id=trace.trace_id)
                 self._finished.append(RequestResult(
                     req.request_id, (), "failed", reason="too_long",
-                    finish_ts=self._clock()))
+                    finish_ts=self._clock(), trace_id=trace.trace_id,
+                    phase_ms=phases or None))
                 continue
             if not self.kv.ledger.can_alloc(final_len):
                 # cache pressure, not impossibility: wait for retirements.
@@ -222,6 +252,8 @@ class ServeEngine:
 
     def _prefill(self, req, prompt_len, final_len):
         slot = self.scheduler.join(req.request_id)
+        trace = serve_tracing.trace_of(req)
+        trace.on_prefill_start(slot, prompt_len)
         self.kv.ledger.alloc_at(slot, prompt_len, reserve=final_len)
         s_pad = self._pad_len(prompt_len)
         tokens = np.zeros((1, s_pad), np.int32)
@@ -238,11 +270,13 @@ class ServeEngine:
         first = int(jax.device_get(tok))
         now = self._clock()
         self._active[slot] = _Active(req, first, prompt_len, now)
+        trace.on_prefill_end(ttft_s=self._active[slot].ttft_s)
         self._m_tokens.labels(phase="prefill").inc(prompt_len)
         self._m_tokens.labels(phase="decode").inc()
         self._m_ttft.observe(self._active[slot].ttft_s)
         self._metrics.event("serve_admit", request_id=req.request_id,
                             slot=slot, prompt_len=prompt_len,
+                            trace_id=trace.trace_id,
                             ttft_s=round(self._active[slot].ttft_s, 6))
         if req.max_new_tokens <= 1:
             self._retire(slot, "completed")
@@ -250,6 +284,10 @@ class ServeEngine:
     def _decode(self):
         if not self._active:
             return False
+        # one span per fused step, its duration attributed to every
+        # request active during the tick (serving/tracing.py)
+        tick = serve_tracing.tick_span(**self.scheduler.snapshot())
+        in_tick = list(self._active.values())
         S = self.kv.num_slots
         tokens = np.zeros(S, np.int32)
         positions = np.zeros(S, np.int32)
@@ -267,6 +305,10 @@ class ServeEngine:
         # the one sanctioned per-step readback: this step's sampled ids
         # hvdlint: disable=HVD011(the per-step batched token readback)
         sampled = np.asarray(jax.device_get(nxt))
+        tick_us = serve_tracing.finish_tick(tick,
+                                            active_slots=len(in_tick))
+        for st in in_tick:
+            serve_tracing.trace_of(st.request).on_decode_tick(tick_us)
         now = self._clock()
         for slot in list(self._active):
             st = self._active[slot]
@@ -295,13 +337,37 @@ class ServeEngine:
         self.scheduler.retire(slot)
         self._m_requests.labels(outcome=outcome).inc()
         now = self._clock()
+        req = st.request
+        trace = serve_tracing.trace_of(req)
+        phases = trace.on_retire(outcome, reason,
+                                 tokens=len(st.generated))
+        # SLO goodput: every token this request cost the device counts
+        # as goodput only if it completed inside its deadline —
+        # otherwise the whole request was wasted work, by reason
+        tokens = len(req.prompt) + len(st.generated)
+        met = (outcome == "completed" and
+               (req.deadline_s is None or
+                now - req.arrival_ts <= req.deadline_s))
+        if met:
+            self._goodput_tokens += tokens
+            self._m_goodput.inc(tokens)
+        else:
+            waste = reason or ("deadline_miss" if outcome == "completed"
+                               else outcome)
+            self._wasted_tokens += tokens
+            self._m_wasted.labels(reason=waste).inc(tokens)
+        total = self._goodput_tokens + self._wasted_tokens
+        if total:
+            self._m_goodput_ratio.set(self._goodput_tokens / total)
         self._metrics.event("serve_retire",
-                            request_id=st.request.request_id, slot=slot,
+                            request_id=req.request_id, slot=slot,
                             outcome=outcome, reason=reason,
-                            tokens=len(st.generated))
+                            tokens=len(st.generated),
+                            trace_id=trace.trace_id)
         self._finished.append(RequestResult(
-            st.request.request_id, tuple(st.generated), outcome,
-            ttft_s=st.ttft_s, finish_ts=now, reason=reason))
+            req.request_id, tuple(st.generated), outcome,
+            ttft_s=st.ttft_s, finish_ts=now, reason=reason,
+            trace_id=trace.trace_id, phase_ms=phases or None))
 
     def _refresh_gauges(self, force=False):
         now = self._clock()

@@ -20,6 +20,7 @@ from typing import Optional
 
 from ..common import config
 from ..utils import metrics as hvd_metrics
+from . import tracing as serve_tracing
 
 
 @dataclass
@@ -30,6 +31,10 @@ class Request:
     queued past it is rejected (reason=deadline) instead of occupying a
     slot it can no longer use. None means the queue-wide admission
     timeout (HVD_SERVE_ADMISSION_TIMEOUT_S) applies alone.
+
+    ``trace`` is the request-path trace (serving/tracing.py) the queue
+    attaches at submit — every request carries its span lifecycle and
+    trace id through admission, prefill and decode.
     """
     request_id: str
     prompt: tuple  # token ids
@@ -37,6 +42,8 @@ class Request:
     temperature: float = 0.0
     deadline_s: Optional[float] = None
     arrival_ts: float = field(default=0.0)
+    trace: Optional[object] = field(default=None, repr=False,
+                                    compare=False)
 
 
 @dataclass
@@ -48,6 +55,8 @@ class RequestResult:
     ttft_s: Optional[float] = None  # arrival -> first token
     finish_ts: float = 0.0
     reason: str = ""  # detail for outcome=failed
+    trace_id: Optional[str] = None  # the request's trace (tracing.py)
+    phase_ms: Optional[dict] = None  # latency decomposition by phase
 
 
 class AdmissionQueue:
@@ -88,6 +97,7 @@ class AdmissionQueue:
         now = self._clock()
         if not request.arrival_ts:
             request.arrival_ts = now
+        serve_tracing.begin(request)  # root + queue_wait spans open here
         with self._lock:
             if len(self._q) >= self.max_depth:
                 self._reject(request, "queue_full")
@@ -113,6 +123,7 @@ class AdmissionQueue:
             if now - req.arrival_ts > budget:
                 self._reject(req, "deadline")
                 continue
+            serve_tracing.trace_of(req).on_pop()
             return req
 
     def requeue(self, request):
@@ -120,13 +131,16 @@ class AdmissionQueue:
         engine's cache-pressure path (no free KV blocks yet). Not a new
         admission: depth may transiently exceed max_depth rather than
         dropping work the queue accepted."""
+        serve_tracing.trace_of(request).on_requeue()
         with self._lock:
             self._q.appendleft(request)
             self._m_depth.set(len(self._q))
 
     def _reject(self, request, reason):
+        trace = serve_tracing.trace_of(request)
+        trace.on_reject(reason)
         self._m_requests.labels(outcome="rejected").inc()
         self._metrics.event("serve_reject", request_id=request.request_id,
-                            reason=reason,
+                            reason=reason, trace_id=trace.trace_id,
                             waited_s=self._clock() - request.arrival_ts
                             if request.arrival_ts else 0.0)

@@ -19,6 +19,7 @@ requests, or fail them loudly), and keep serving.
 
 from ..common.config import HorovodConfig
 from ..ops import negotiation as neg
+from . import tracing as serve_tracing
 
 
 class ReplicaGroup:
@@ -61,10 +62,13 @@ class ReplicaGroup:
         """One liveness cycle. Raises RanksLostError (naming the dead
         ranks) once the coordinator's ledger declares peers lost; any
         transport error surfaces to the caller too — silence is the one
-        thing this method must never produce."""
-        resp = self._worker.cycle([], -1, req_id=self._req_id)
-        self._req_id += 1
-        neg.raise_if_ranks_lost(resp)
+        thing this method must never produce. The span makes a slow
+        control plane visible in the request-path story (a RanksLost
+        heartbeat aborts the span, which the failover dump keeps)."""
+        with serve_tracing.heartbeat_span(replica=self.rank):
+            resp = self._worker.cycle([], -1, req_id=self._req_id)
+            self._req_id += 1
+            neg.raise_if_ranks_lost(resp)
         return resp
 
     def close(self, linger_s=0.5):

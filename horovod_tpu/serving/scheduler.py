@@ -67,3 +67,17 @@ class SlotScheduler:
         self._free.append(slot)
         if not self.active:
             self._wave_started = False
+
+    def snapshot(self):
+        """Occupancy summary for span attrs / the failover dump: which
+        request owns which slot right now (serving/tracing.py stamps
+        this onto decode_tick spans so a flight dump shows the batch
+        composition at every step)."""
+        return {
+            "policy": self.policy,
+            "occupied": len(self.active),
+            "free": len(self._free),
+            "wave_started": self._wave_started,
+            "slots": {int(s): rid for s, rid in sorted(
+                self.active.items())},
+        }

@@ -84,6 +84,12 @@ LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 RATIO_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 # Small-count buckets (tensors per cycle / per bucket).
 COUNT_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+# Serving request-phase buckets (seconds): like LATENCY_BUCKETS but
+# extended past 10 s — queue_wait under overload legally runs up to the
+# admission timeout (HVD_SERVE_ADMISSION_TIMEOUT_S, default 10 s), so
+# the default buckets would saturate exactly where the p99 lives and
+# histogram_quantile could only answer ">10s".
+SERVE_PHASE_BUCKETS = LATENCY_BUCKETS + (30.0, 60.0)
 
 
 class Counter:

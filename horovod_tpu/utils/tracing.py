@@ -55,7 +55,23 @@ EXECUTE = "execute"
 CALLBACK = "callback"
 STEP = "step"
 CYCLE = "cycle"          # coordinator-side: one _negotiate() pass
-STAGES = (ENQUEUE, NEGOTIATE, FUSION, EXECUTE, CALLBACK, STEP, CYCLE)
+# serving-plane request-path stages (serving/tracing.py): every Request
+# becomes one trace — a REQUEST root span from arrival to terminal
+# outcome, QUEUE_WAIT children for each stay in the admission queue
+# (re-queues under KV pressure open a fresh one), PREFILL for the
+# prompt pass, DECODE for the slot residency (carries the slot attr the
+# Perfetto export lanes on), one DECODE_TICK per fused engine step, and
+# HEARTBEAT for the replica-liveness RPC. Span catalog: docs/tracing.md.
+REQUEST = "request"
+QUEUE_WAIT = "queue_wait"
+PREFILL = "prefill"
+DECODE = "decode"
+DECODE_TICK = "decode_tick"
+HEARTBEAT = "heartbeat"
+SERVE_STAGES = (REQUEST, QUEUE_WAIT, PREFILL, DECODE, DECODE_TICK,
+                HEARTBEAT)
+STAGES = (ENQUEUE, NEGOTIATE, FUSION, EXECUTE, CALLBACK, STEP,
+          CYCLE) + SERVE_STAGES
 
 
 class Span:

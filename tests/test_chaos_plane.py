@@ -787,10 +787,11 @@ class TestDrillServingReplicaLost:
             port = int(os.environ["DRILL_PORT"])
             done_file = os.environ["DRILL_DONE_FILE"]
             hvd_tracing.reset(enabled=True, rank=r)
-            group = ReplicaGroup(r, 2, ("127.0.0.1", port), key=b"k" * 32,
-                                 rank_lost_timeout_s=1.5,
-                                 start_timeout_s=120.0)
             if r == 1:
+                group = ReplicaGroup(r, 2, ("127.0.0.1", port),
+                                     key=b"k" * 32,
+                                     rank_lost_timeout_s=1.5,
+                                     start_timeout_s=120.0)
                 # the victim: a few healthy heartbeats, then silence
                 for _ in range(3):
                     group.heartbeat()
@@ -802,10 +803,23 @@ class TestDrillServingReplicaLost:
                 group.close(linger_s=0.0)
                 return (r, None, None, None)
 
-            # replica 0: a real serving engine riding the group
+            # replica 0: a real serving engine riding the group. Warm
+            # the jit caches BEFORE joining — multi-second compiles
+            # inside the group would stall rank 0's own heartbeats past
+            # the 1.5s window and the coordinator's ledger (triggered by
+            # the victim's cycles) would declare the WRONG rank lost.
             cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
                                             attention_impl="full")
             _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            warm = ServeEngine(
+                cfg, params, num_slots=2, max_len=32, kv_block=8,
+                queue=AdmissionQueue(max_depth=8,
+                                     admission_timeout_s=1e9))
+            warm.submit(Request("warm", (3, 1, 4), max_new_tokens=4))
+            warm.run_to_completion()
+            group = ReplicaGroup(r, 2, ("127.0.0.1", port), key=b"k" * 32,
+                                 rank_lost_timeout_s=1.5,
+                                 start_timeout_s=120.0)
             lost_box = []
             queue = AdmissionQueue(max_depth=32, admission_timeout_s=1e9)
             engine = ServeEngine(
@@ -823,6 +837,10 @@ class TestDrillServingReplicaLost:
                 if lost_box:
                     detect_s = time.monotonic() - t0
                     break
+                # pace the decode so pre-* are still mid-stream when
+                # the loss lands: the flight dump must catch real
+                # in-flight work, not an idle engine
+                time.sleep(0.15)
             # release the victim before any assertion can exit early
             with open(done_file, "w") as f:
                 f.write("done")
@@ -865,6 +883,27 @@ class TestDrillServingReplicaLost:
         hvd_postmortem.rebase(loaded)
         verdict = hvd_postmortem.analyze(loaded)
         assert verdict["divergent_rank"] == 1, verdict
+
+        # the dump caught the in-flight requests: their request-path
+        # spans are open, the failover event names them, and both
+        # analyzers surface them by id
+        (dump0,) = [d for d in loaded if d.get("rank") == 0]
+        open_requests = sorted(
+            s["tensor"] for s in dump0.get("open_spans", [])
+            if s.get("stage") == "request")
+        assert open_requests == ["pre-0", "pre-1"], dump0.get(
+            "open_spans")
+        (failover,) = [e for e in dump0.get("events", [])
+                       if e.get("event") == "serve_failover"]
+        assert failover["inflight"] == ["pre-0", "pre-1"], failover
+        assert verdict["inflight_requests"] == ["pre-0", "pre-1"], \
+            verdict
+        assert any("pre-0" in r for r in verdict["reasons"]), \
+            verdict["reasons"]
+        import hvd_slo
+        slo = hvd_slo.analyze_serve(loaded)
+        assert slo["inflight"] == ["pre-0", "pre-1"], slo
+        assert "pre-0" in slo["verdict"], slo["verdict"]
 
 
 # ---------------------------------------------------------------------------

@@ -892,6 +892,83 @@ def test_hvd013_suppression_honored(tmp_path):
         ["HVD013"]
 
 
+def test_hvd014_triggers_on_request_ts_delta(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_path
+
+        def retire(now, req, st):
+            ttft = now - req.arrival_ts
+            gap = now - st.last_token_ts
+            return ttft, gap
+        """)
+    assert [f.rule for f in live(found)] == ["HVD014"] * 2
+
+
+def test_hvd014_triggers_in_real_serving_path(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "serving"
+    mod.mkdir(parents=True)
+    f = mod / "engine.py"
+    f.write_text(textwrap.dedent("""\
+        def deadline_left(now, req):
+            return req.deadline_s - (now - req.arrival_ts)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD014"]
+
+
+def test_hvd014_trace_layer_is_sanctioned(tmp_path):
+    # serving/tracing.py IS the request-timing layer: the same delta
+    # there is the instrument, not a rival
+    mod = tmp_path / "horovod_tpu" / "serving"
+    mod.mkdir(parents=True)
+    f = mod / "tracing.py"
+    f.write_text(textwrap.dedent("""\
+        def waited(now, req):
+            return now - req.arrival_ts
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd014_non_ts_deltas_and_outside_scope_clean(tmp_path):
+    # subtraction per se is fine — only request-lifecycle timestamp
+    # attributes mark a latency measurement
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_path
+
+        def trim(req, budget):
+            return len(req.prompt) - budget
+
+        def room(ledger):
+            return ledger.capacity - ledger.used
+        """)
+    assert live(found) == []
+    # outside the serving plane the same delta is someone else's
+    # business (bench harnesses, tests)
+    found = lint_source(tmp_path, """\
+        def waited(now, req):
+            return now - req.arrival_ts
+        """)
+    assert live(found) == []
+
+
+def test_hvd014_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_path
+
+        def observe_ttft(hist, now, req):
+            # hvdlint: disable=HVD014(TTFT histogram on the shared registry consumes this delta)
+            hist.observe(now - req.arrival_ts)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD014"]
+
+
 # ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
@@ -952,7 +1029,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 14)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 15)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

@@ -1,0 +1,424 @@
+"""Request-path tracing (horovod_tpu/serving/tracing.py): span
+lifecycle and exact phase decomposition on a fake clock, the queue and
+engine integration (trace ids in results/events, goodput accounting,
+KV-pressure requeues), flight-dump reconstruction of in-flight
+requests, and the acceptance drill — inject a synthetic slow phase
+(delayed prefill, forced KV-pressure requeue) and assert the hvd_slo
+tail verdict names it."""
+
+import os
+import sys
+import time
+
+import numpy as np  # noqa: F401 - keeps the jax import path warm
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from horovod_tpu.serving import tracing as serve_tracing
+from horovod_tpu.serving.queue import AdmissionQueue, Request
+from horovod_tpu.utils import metrics as hvd_metrics
+from horovod_tpu.utils import tracing as hvd_tracing
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import hvd_postmortem  # noqa: E402
+import hvd_slo  # noqa: E402
+
+
+@pytest.fixture
+def reg():
+    """Live metrics registry + live tracer, torn down to env defaults."""
+    r = hvd_metrics.reset(enabled=True)
+    hvd_tracing.reset(enabled=True, rank=0)
+    yield r
+    hvd_tracing.reset()
+    hvd_metrics.reset()
+
+
+def _value(snap, name, **labels):
+    fam = snap["metrics"].get(name)
+    if fam is None:
+        return None
+    for v in fam["values"]:
+        if all(v["labels"].get(k) == lv for k, lv in labels.items()):
+            return v.get("value", v.get("count"))
+    return None
+
+
+def _events(snap, kind):
+    return [e for e in snap["events"] if e["event"] == kind]
+
+
+class FakeUsClock:
+    """Deterministic microsecond clock with the tracer's interface."""
+
+    def __init__(self):
+        self.now_us = 0.0
+        self.epoch_us_at_ts0 = 1_700_000_000_000_000
+
+    def ts_us(self):
+        return self.now_us
+
+    def epoch_us(self, ts_us=None):
+        return self.epoch_us_at_ts0 + (
+            self.now_us if ts_us is None else ts_us)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace lifecycle on a fake clock: exact decomposition
+# ---------------------------------------------------------------------------
+
+class TestRequestTrace:
+    def _tracer(self):
+        return hvd_tracing.Tracer(rank=0, clock=FakeUsClock())
+
+    def test_phase_decomposition_is_exact(self):
+        tracer = self._tracer()
+        clock = tracer.clock
+        t = serve_tracing.RequestTrace(tracer, "r0").on_submit()
+        clock.now_us += 5_000  # 5 ms queue_wait
+        t.on_pop()
+        for _ in range(2):  # 2 requeues, 3 ms each
+            t.on_requeue()
+            clock.now_us += 3_000
+            t.on_pop()
+        t.on_prefill_start(slot=1, prompt_len=4)
+        clock.now_us += 7_000  # 7 ms prefill
+        t.on_prefill_end(ttft_s=0.012)
+        for _ in range(2):  # 2 decode ticks, 4 ms each
+            clock.now_us += 4_000
+            t.on_decode_tick(4_000)
+        clock.now_us += 2_000  # 2 ms the ticks don't cover: the stall
+        phases = t.on_retire("completed", tokens=8)
+        assert phases == {"queue_wait": 5.0, "requeue": 6.0,
+                          "prefill": 7.0, "decode": 8.0,
+                          "scheduler_stall": 2.0}
+        root = [s for s in tracer.spans()
+                if s["stage"] == hvd_tracing.REQUEST]
+        assert len(root) == 1
+        attrs = root[0]["attrs"]
+        assert attrs["outcome"] == "completed"
+        assert attrs["slot"] == 1
+        assert attrs["requeues"] == 2
+        assert attrs["phase_ms"] == phases
+        # every serve stage the lifecycle visited closed into the ring
+        stages = {s["stage"] for s in tracer.spans()}
+        assert {hvd_tracing.REQUEST, hvd_tracing.QUEUE_WAIT,
+                hvd_tracing.PREFILL, hvd_tracing.DECODE} <= stages
+        assert tracer.open_spans() == []
+
+    def test_reject_closes_root_as_error(self):
+        tracer = self._tracer()
+        t = serve_tracing.RequestTrace(tracer, "r0").on_submit()
+        tracer.clock.now_us += 2_000
+        phases = t.on_reject("queue_full")
+        assert phases["queue_wait"] == 2.0
+        (root,) = [s for s in tracer.spans()
+                   if s["stage"] == hvd_tracing.REQUEST]
+        assert root["status"] == "error"
+        assert root["attrs"]["outcome"] == "rejected"
+        assert root["attrs"]["reason"] == "queue_full"
+        assert tracer.open_spans() == []
+
+    def test_close_is_idempotent(self):
+        tracer = self._tracer()
+        t = serve_tracing.RequestTrace(tracer, "r0").on_submit()
+        t.on_pop()
+        first = t.on_retire("completed")
+        tracer.clock.now_us += 9_000
+        assert t.on_retire("failed") == first  # no re-close, no drift
+        roots = [s for s in tracer.spans()
+                 if s["stage"] == hvd_tracing.REQUEST]
+        assert len(roots) == 1
+
+    def test_crash_mid_request_leaves_open_spans(self):
+        # the failover-dump contract: an unretired request is visible
+        # as open spans, never silently dropped
+        tracer = self._tracer()
+        t = serve_tracing.RequestTrace(tracer, "r0").on_submit()
+        t.on_pop()
+        t.on_prefill_start(slot=0, prompt_len=2)
+        t.on_prefill_end()
+        open_stages = {s.stage for s in tracer.open_spans()}
+        assert {hvd_tracing.REQUEST, hvd_tracing.DECODE} <= open_stages
+
+
+class TestBeginAttach:
+    def test_begin_attaches_once_and_replaces_closed(self, reg):
+        req = Request("a", (1, 2))
+        t1 = serve_tracing.begin(req)
+        assert serve_tracing.begin(req) is t1  # live: idempotent
+        t1.on_pop()
+        t1.on_retire("completed")
+        t2 = serve_tracing.begin(req)  # resubmission: fresh lifecycle
+        assert t2 is not t1 and not t2.closed
+
+    def test_disabled_attaches_shared_null(self, reg, monkeypatch):
+        monkeypatch.setenv("HVD_SERVE_TRACE", "0")
+        req = Request("a", (1, 2))
+        assert serve_tracing.begin(req) is serve_tracing._NULL_TRACE
+        assert serve_tracing.trace_of(req).phase_ms() == {}
+        # re-enabling replaces the null on the next submit
+        monkeypatch.delenv("HVD_SERVE_TRACE")
+        assert isinstance(serve_tracing.begin(req),
+                          serve_tracing.RequestTrace)
+
+    def test_trace_of_never_returns_none(self):
+        assert serve_tracing.trace_of(Request("a", (1,))) is \
+            serve_tracing._NULL_TRACE
+
+
+# ---------------------------------------------------------------------------
+# AdmissionQueue integration (no jax)
+# ---------------------------------------------------------------------------
+
+class TestQueueIntegration:
+    def test_submit_pop_requeue_drive_wait_spans(self, reg):
+        q = AdmissionQueue(max_depth=4, admission_timeout_s=10.0)
+        req = Request("a", (1, 2))
+        q.submit(req)
+        trace = serve_tracing.trace_of(req)
+        assert isinstance(trace, serve_tracing.RequestTrace)
+        got = q.pop()
+        assert got is req
+        q.requeue(req)
+        assert trace.requeues == 1
+        q.pop()
+        trace.on_retire("completed")
+        tracer = hvd_tracing.get_tracer()
+        waits = [s for s in tracer.spans()
+                 if s["stage"] == hvd_tracing.QUEUE_WAIT]
+        assert len(waits) == 2
+        assert [bool((s.get("attrs") or {}).get("requeue"))
+                for s in waits] == [False, True]
+
+    def test_queue_full_reject_carries_trace_id(self, reg):
+        q = AdmissionQueue(max_depth=1, admission_timeout_s=10.0)
+        q.submit(Request("a", (1,)))
+        rej = Request("b", (1,))
+        assert not q.submit(rej)
+        trace = serve_tracing.trace_of(rej)
+        assert trace.closed
+        (ev,) = _events(reg.snapshot(), "serve_reject")
+        assert ev["trace_id"] == trace.trace_id
+        assert ev["reason"] == "queue_full"
+
+    def test_deadline_reject_closes_trace(self, reg):
+        clock = FakeClock()
+        q = AdmissionQueue(max_depth=8, admission_timeout_s=5.0,
+                           clock=clock)
+        stale = Request("stale", (1,), deadline_s=1.0)
+        q.submit(stale)
+        clock.t = 2.0
+        assert q.pop() is None
+        assert serve_tracing.trace_of(stale).closed
+        (root,) = [s for s in hvd_tracing.get_tracer().spans()
+                   if s["stage"] == hvd_tracing.REQUEST]
+        assert root["attrs"]["reason"] == "deadline"
+
+
+# ---------------------------------------------------------------------------
+# ServeEngine integration (CPU, tiny fp32 config)
+# ---------------------------------------------------------------------------
+
+def _tiny():
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.models import transformer as tr
+    cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                    attention_impl="full")
+    _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    from horovod_tpu.serving.engine import ServeEngine
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("kv_block", 8)
+    kw.setdefault("queue", AdmissionQueue(max_depth=64,
+                                          admission_timeout_s=1e9))
+    return ServeEngine(cfg, params, **kw)
+
+
+class TestEngineIntegration:
+    def test_results_carry_trace_id_and_phases(self, reg):
+        cfg, params = _tiny()
+        engine = _engine(cfg, params)
+        engine.submit(Request("a", (3, 1, 4), max_new_tokens=5))
+        (res,) = engine.run_to_completion()
+        assert res.outcome == "completed"
+        assert res.trace_id
+        assert set(res.phase_ms) == set(serve_tracing.PHASES)
+        assert res.phase_ms["prefill"] > 0
+        assert res.phase_ms["decode"] > 0
+        snap = reg.snapshot()
+        # the decomposition reached the histogram, every phase labeled
+        for phase in serve_tracing.PHASES:
+            assert _value(snap, "hvd_serve_phase_seconds",
+                          phase=phase) == 1, phase
+        (admit,) = _events(snap, "serve_admit")
+        (retire,) = _events(snap, "serve_retire")
+        assert admit["trace_id"] == res.trace_id
+        assert retire["trace_id"] == res.trace_id
+        # all-met goodput: every prefill+decode token counts, none wasted
+        assert _value(snap, "hvd_serve_goodput_tokens_total") == 8.0
+        assert _value(snap, "hvd_serve_goodput_ratio") == 1.0
+        assert "hvd_serve_wasted_tokens_total" not in snap["metrics"] or \
+            not snap["metrics"]["hvd_serve_wasted_tokens_total"]["values"]
+
+    def test_deadline_failure_counts_wasted_tokens(self, reg):
+        cfg, params = _tiny()
+        clock = FakeClock()
+        queue = AdmissionQueue(max_depth=8, admission_timeout_s=1e9,
+                               clock=clock)
+        engine = _engine(cfg, params, queue=queue, clock=clock)
+        engine.submit(Request("slow", (1, 2), max_new_tokens=20,
+                              deadline_s=5.0))
+        engine.step()
+        clock.t = 6.0
+        for _ in range(5):
+            if engine.run_to_completion(max_steps=1):
+                break
+        snap = reg.snapshot()
+        assert (_value(snap, "hvd_serve_wasted_tokens_total",
+                       reason="deadline") or 0) > 0
+        assert _value(snap, "hvd_serve_goodput_ratio") == 0.0
+        assert _value(snap, "hvd_serve_goodput_tokens_total") in (None,
+                                                                  0.0)
+
+    def test_kv_pressure_requeues_are_traced(self, reg):
+        cfg, params = _tiny()
+        engine = _engine(cfg, params, num_slots=2, max_len=16,
+                         total_blocks=2)
+        engine.submit(Request("a", tuple(range(1, 9)), max_new_tokens=4))
+        engine.submit(Request("b", tuple(range(1, 9)), max_new_tokens=4))
+        results = {r.request_id: r
+                   for r in engine.run_to_completion()}
+        assert results["b"].phase_ms["requeue"] > 0
+        roots = {s["tensor"]: s for s in hvd_tracing.get_tracer().spans()
+                 if s["stage"] == hvd_tracing.REQUEST}
+        assert roots["b"]["attrs"]["requeues"] >= 1
+        assert roots["a"]["attrs"]["requeues"] == 0
+
+    def test_flight_dump_names_inflight_requests(self, reg):
+        cfg, params = _tiny()
+        engine = _engine(cfg, params)
+        engine.submit(Request("stuck", (1, 2, 3), max_new_tokens=40))
+        engine.step()
+        engine.step()  # mid-decode: the request is in flight
+        dump = hvd_tracing.get_tracer().flight_snapshot("unit_test")
+        open_by_stage = {}
+        for s in dump["open_spans"]:
+            open_by_stage.setdefault(s["stage"], []).append(s["tensor"])
+        assert "stuck" in open_by_stage.get(hvd_tracing.REQUEST, [])
+        assert "stuck" in open_by_stage.get(hvd_tracing.DECODE, [])
+        # hvd_slo reconstructs it as in-flight work with real phases
+        records = hvd_slo.requests_from_dumps([dump])
+        (rec,) = [r for r in records if r["request_id"] == "stuck"]
+        assert rec["inflight"] and rec["outcome"] == "inflight"
+        assert rec["phase_ms"]["prefill"] > 0
+        # and the postmortem names it in the blame reasons
+        hvd_postmortem.rebase([dump])
+        verdict = hvd_postmortem.analyze([dump])
+        assert verdict["inflight_requests"] == ["stuck"]
+        assert any("stuck" in r for r in verdict["reasons"])
+        engine.run_to_completion()  # drain: no leaked slots after
+
+    def test_tracing_off_engine_still_serves(self, reg, monkeypatch):
+        monkeypatch.setenv("HVD_SERVE_TRACE", "0")
+        cfg, params = _tiny()
+        engine = _engine(cfg, params)
+        engine.submit(Request("a", (3, 1, 4), max_new_tokens=5))
+        (res,) = engine.run_to_completion()
+        assert res.outcome == "completed"
+        assert res.trace_id is None and res.phase_ms is None
+        tracer = hvd_tracing.get_tracer()
+        assert not [s for s in tracer.spans()
+                    if s["stage"] in hvd_tracing.SERVE_STAGES]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: inject a slow phase, the verdict must name it
+# ---------------------------------------------------------------------------
+
+class TestSlowPhaseAttribution:
+    def test_delayed_prefill_dominates_tail(self, reg, monkeypatch):
+        from horovod_tpu.serving import engine as engine_mod
+        cfg, params = _tiny()
+        engine = _engine(cfg, params, num_slots=2)
+        # untimed warmup: compiles must not pollute the measured phases
+        engine.submit(Request("warm-a", (1, 2, 3), max_new_tokens=4))
+        engine.submit(Request("warm-b", (1, 2, 3, 4, 5),
+                              max_new_tokens=4))
+        engine.run_to_completion()
+        hvd_tracing.reset(enabled=True, rank=0)
+
+        real = engine_mod._prefill_jit
+
+        def delayed(cfg_, params_, tokens, last, temp, rng):
+            if int(last) >= 4:  # the 5-token prompts are the slow ones
+                time.sleep(0.15)
+            return real(cfg_, params_, tokens, last, temp, rng)
+
+        monkeypatch.setattr(engine_mod, "_prefill_jit", delayed)
+        # one request in flight at a time: the tail must be owned by
+        # the injected prefill delay, not by slot contention
+        results = []
+        for rid, prompt in [("fast-0", (1, 2, 3)), ("fast-1", (1, 2, 3)),
+                            ("slow-0", (1, 2, 3, 4, 5)),
+                            ("fast-2", (1, 2, 3)),
+                            ("slow-1", (1, 2, 3, 4, 5)),
+                            ("fast-3", (1, 2, 3))]:
+            engine.submit(Request(rid, prompt, max_new_tokens=4))
+            results.extend(engine.run_to_completion())
+        assert len(results) == 6
+
+        dump = hvd_tracing.get_tracer().flight_snapshot("drill")
+        verdict = hvd_slo.analyze_serve([dump], pct=70)
+        assert verdict["requests"] == 6
+        assert {r["request_id"] for r in verdict["tail"]} == \
+            {"slow-0", "slow-1"}
+        assert verdict["dominant_phase"] == "prefill"
+        assert "dominated by prefill" in verdict["verdict"]
+        assert not verdict["kv_pressure"]
+
+    def test_kv_pressure_requeue_dominates_tail(self, reg):
+        cfg, params = _tiny()
+        engine = _engine(cfg, params, num_slots=2, max_len=16,
+                         total_blocks=2)
+        engine.submit(Request("warm", tuple(range(1, 9)),
+                              max_new_tokens=4))
+        engine.run_to_completion()
+        hvd_tracing.reset(enabled=True, rank=0)
+
+        # "a" holds the whole block budget for 8 decode steps; "b"
+        # bounces off the ledger every step until "a" retires
+        engine.submit(Request("a", tuple(range(1, 9)),
+                              max_new_tokens=8))
+        engine.submit(Request("b", tuple(range(1, 9)),
+                              max_new_tokens=2))
+        results = engine.run_to_completion()
+        assert all(r.outcome == "completed" for r in results)
+
+        dump = hvd_tracing.get_tracer().flight_snapshot("drill")
+        verdict = hvd_slo.analyze_serve([dump], pct=50)
+        (tail,) = verdict["tail"]
+        assert tail["request_id"] == "b"
+        assert tail["requeues"] >= 1
+        assert verdict["dominant_phase"] in ("queue_wait", "requeue")
+        assert verdict["kv_pressure"]
+        assert "KV pressure" in verdict["verdict"]
+
+    def test_selftest_passes(self, capsys):
+        assert hvd_slo.selftest() == 0
+        assert "ok" in capsys.readouterr().out

@@ -237,6 +237,32 @@ def analyze(dumps):
             if e.get("event") == "chaos_injection":
                 chaos.append({"rank": _rank_of(d), **e})
 
+    # 5. serving plane: requests still in flight when the dump fired —
+    # open serve spans (their tensor is the request id) and the
+    # serve_failover event's inflight list both name the work a replica
+    # loss killed mid-stream. tools/hvd_slo.py attributes their latency.
+    serve_stages = set(hvd_tracing.SERVE_STAGES)
+    inflight = set()
+    for d in dumps:
+        for s in d.get("open_spans", []):
+            if s.get("stage") in serve_stages and s.get("tensor"):
+                inflight.add(s["tensor"])
+        for e in d.get("events", []):
+            if e.get("event") == "serve_failover":
+                named = [str(r) for r in e.get("inflight", [])]
+                inflight.update(named)
+                reasons.append(
+                    f"rank {_rank_of(d)} failed over serving (lost "
+                    f"ranks {e.get('lost_ranks')}) with "
+                    f"{len(named)} request(s) in flight: "
+                    f"{sorted(named)}")
+    if inflight:
+        reasons.append(
+            f"serving: requests {sorted(inflight)} have open "
+            "request-path spans in the dump — in-flight work at "
+            "failure time (run tools/hvd_slo.py for the tail "
+            "attribution)")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -282,6 +308,7 @@ def analyze(dumps):
         "chaos_injections": chaos,
         "numerics_anomalies": numerics,
         "first_bad_cycle": first_bad,
+        "inflight_requests": sorted(inflight),
     }
 
 
@@ -330,6 +357,9 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
         lines.append(f"  blocking tensor: {verdict['tensor']}{tid}")
     if verdict.get("first_bad_cycle") is not None:
         lines.append(f"  first bad cycle: {verdict['first_bad_cycle']}")
+    if verdict.get("inflight_requests"):
+        lines.append(f"  in-flight serve requests: "
+                     f"{verdict['inflight_requests']}")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -377,7 +407,8 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
         for e in d.get("events", []):
             if e.get("event") in ("stall", "stall_kill", "ranks_lost",
                                   "chaos_injection", "slow_span",
-                                  "numerics_anomaly"):
+                                  "numerics_anomaly", "serve_failover",
+                                  "slow_decode_tick"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
@@ -429,7 +460,8 @@ def chrome_trace(dumps, stitched):
         for e in d.get("events", []):
             kind = e.get("event")
             if kind in ("stall", "stall_kill", "ranks_lost",
-                        "chaos_injection", "numerics_anomaly"):
+                        "chaos_injection", "numerics_anomaly",
+                        "serve_failover"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

@@ -1,0 +1,457 @@
+"""hvd_slo: tail-latency attribution for the serving plane.
+
+Digests the flight-recorder dumps the request-path tracing layer
+(horovod_tpu/serving/tracing.py) leaves behind — ``flight-rank*.json``
+under ``HVD_FLIGHT_DIR``, written on serve_failover, SIGTERM, or an
+explicit ``Tracer.dump()`` — reconstructs every request's latency
+decomposition from its spans, classifies the slowest-percentile
+requests by their DOMINANT phase, and names the verdict::
+
+    p90 dominated by queue_wait under KV pressure (avg 3.5 requeues)
+    p90 dominated by prefill
+
+Phases are the ones serving/tracing.py accounts: queue_wait (submit to
+first admission), requeue (KV-pressure bounces), prefill, decode, and
+scheduler_stall (the residual). Completed requests carry the exact
+decomposition in their ``request`` root span's ``phase_ms`` attrs;
+in-flight requests (open spans at dump time — the serve_failover case)
+are reconstructed from their child spans, extended to the dump
+timestamp, and reported separately: they are the work a replica loss
+killed.
+
+Output: a human report on stdout, ``--json`` for the machine verdict
+(the chaos drills assert on it), and ``--trace out.json`` for a
+Chrome/Perfetto export of the slot timeline — one pid per rank, one
+lane per batch slot (prefill + decode residency), plus queue and
+engine lanes. ``--selftest`` runs the analyzer against two synthetic
+trace sets (a KV-pressure tail, a slow-prefill tail) and asserts each
+verdict names the injected phase.
+
+Usage:
+    python tools/hvd_slo.py [--dir DIR | dump.json ...]
+        [--pct P] [--json] [--trace out.json] [--out report.txt]
+
+Runbook: docs/troubleshooting.md ("Why is my p99 slow").
+"""
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+try:
+    from horovod_tpu.utils import tracing as hvd_tracing
+except ImportError:  # run straight from a checkout: tools/ is no package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from horovod_tpu.utils import tracing as hvd_tracing
+
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import hvd_postmortem
+else:  # pragma: no cover - tools/ used as a package
+    from . import hvd_postmortem
+
+PHASES = ("queue_wait", "requeue", "prefill", "decode",
+          "scheduler_stall")
+
+
+# -- per-request reconstruction ---------------------------------------------
+
+def requests_from_dumps(dumps):
+    """One record per request found in the dumps.
+
+    Retired requests: their closed ``request`` root span carries the
+    exact ``phase_ms`` decomposition serving/tracing.py computed at
+    close. In-flight requests (root still open at dump time): phases
+    are re-derived from the child spans, with open spans extended to
+    the dump timestamp — decode attribution is the open slot-residency
+    span, so it includes any stall, which is the honest reading of a
+    request that never got to retire.
+    """
+    records = []
+    for d in dumps:
+        rank = d.get("rank")
+        dump_ts = d.get("ts_us", 0)
+        closed = d.get("spans", [])
+        opened = d.get("open_spans", [])
+        # children by trace_id, for the in-flight reconstruction
+        children = collections.defaultdict(list)
+        for s in closed + opened:
+            if s.get("stage") in (hvd_tracing.QUEUE_WAIT,
+                                  hvd_tracing.PREFILL,
+                                  hvd_tracing.DECODE):
+                children[s.get("trace_id")].append(s)
+
+        for s in closed:
+            if s.get("stage") != hvd_tracing.REQUEST:
+                continue
+            attrs = s.get("attrs") or {}
+            records.append({
+                "request_id": s.get("tensor"),
+                "trace_id": s.get("trace_id"),
+                "rank": rank,
+                "inflight": False,
+                "outcome": attrs.get("outcome", "?"),
+                "reason": attrs.get("reason", ""),
+                "slot": attrs.get("slot"),
+                "requeues": attrs.get("requeues", 0),
+                "total_ms": ((s.get("end_us") or 0) -
+                             s.get("start_us", 0)) / 1e3,
+                "phase_ms": dict(attrs.get("phase_ms") or {}),
+            })
+        for s in opened:
+            if s.get("stage") != hvd_tracing.REQUEST:
+                continue
+            phases = dict.fromkeys(PHASES, 0.0)
+            requeues = 0
+            slot = None
+            for c in children.get(s.get("trace_id"), []):
+                end = c.get("end_us")
+                dur_ms = ((end if end is not None else dump_ts) -
+                          c.get("start_us", 0)) / 1e3
+                cattrs = c.get("attrs") or {}
+                stage = c["stage"]
+                if stage == hvd_tracing.QUEUE_WAIT:
+                    if cattrs.get("requeue"):
+                        phases["requeue"] += dur_ms
+                        requeues += 1
+                    else:
+                        phases["queue_wait"] += dur_ms
+                elif stage == hvd_tracing.PREFILL:
+                    phases["prefill"] += dur_ms
+                    slot = cattrs.get("slot", slot)
+                elif stage == hvd_tracing.DECODE:
+                    phases["decode"] += dur_ms
+                    slot = cattrs.get("slot", slot)
+            total_ms = (dump_ts - s.get("start_us", 0)) / 1e3
+            phases["scheduler_stall"] = max(
+                total_ms - sum(phases.values()), 0.0)
+            records.append({
+                "request_id": s.get("tensor"),
+                "trace_id": s.get("trace_id"),
+                "rank": rank,
+                "inflight": True,
+                "outcome": "inflight",
+                "reason": "",
+                "slot": slot,
+                "requeues": requeues,
+                "total_ms": total_ms,
+                "phase_ms": {k: round(v, 3) for k, v in phases.items()},
+            })
+    return records
+
+
+# -- tail classification ----------------------------------------------------
+
+def _dominant(record):
+    phases = record.get("phase_ms") or {}
+    if not phases:
+        return None
+    return max(PHASES, key=lambda p: phases.get(p, 0.0))
+
+
+def analyze_serve(dumps, pct=None):
+    """The tail verdict: which phase owns the slow requests, and why.
+
+    Takes the slowest (100-pct)% of requests by end-to-end latency
+    (always at least one), classifies each by its dominant phase, and
+    votes. A queue_wait/requeue-dominated tail whose requests were
+    bounced back by the block ledger (requeues > 0) is flagged as KV
+    pressure — the queue was not slow, the cache was full.
+    """
+    if pct is None:
+        pct = float(os.environ.get("HVD_SLO_PCT", "90"))
+    records = requests_from_dumps(dumps)
+    records.sort(key=lambda r: r["total_ms"], reverse=True)
+    out = {
+        "requests": len(records),
+        "pct": pct,
+        "inflight": sorted(r["request_id"] for r in records
+                           if r["inflight"]),
+        "tail": [],
+        "dominant_phase": None,
+        "kv_pressure": False,
+        "verdict": "no serve requests in the dumps",
+        "phase_mean_ms": {},
+    }
+    if not records:
+        return out
+    n_tail = max(1, int(round(len(records) * (100.0 - pct) / 100.0)))
+    tail = records[:n_tail]
+    votes = collections.Counter(
+        d for d in (_dominant(r) for r in tail) if d)
+    out["tail"] = tail
+    out["phase_mean_ms"] = {
+        p: round(sum((r["phase_ms"] or {}).get(p, 0.0)
+                     for r in tail) / len(tail), 3)
+        for p in PHASES}
+    if not votes:
+        out["verdict"] = (f"p{pct:g}: {len(tail)} tail request(s) carry "
+                          "no phase decomposition (tracing off?)")
+        return out
+    dominant = votes.most_common(1)[0][0]
+    out["dominant_phase"] = dominant
+    verdict = f"p{pct:g} dominated by {dominant}"
+    requeued = [r for r in tail if r.get("requeues", 0) > 0]
+    if dominant in ("queue_wait", "requeue") and requeued:
+        out["kv_pressure"] = True
+        avg = sum(r["requeues"] for r in requeued) / len(requeued)
+        verdict += (f" under KV pressure ({len(requeued)}/{len(tail)} "
+                    f"tail requests requeued, avg {avg:.1f} requeues)")
+    if out["inflight"]:
+        verdict += (f"; {len(out['inflight'])} request(s) still in "
+                    f"flight at dump time: {out['inflight']}")
+    out["verdict"] = verdict
+    return out
+
+
+# -- rendering --------------------------------------------------------------
+
+def render_report(dumps, verdict):
+    lines = []
+    lines.append("=" * 72)
+    lines.append("HVD SLO — serve tail-latency attribution")
+    lines.append("=" * 72)
+    for d in dumps:
+        lines.append(f"  rank {d.get('rank')}: "
+                     f"{len(d.get('spans', []))} spans, "
+                     f"{len(d.get('open_spans', []))} open "
+                     f"(reason: {d.get('reason') or '?'})")
+    lines.append(f"  requests reconstructed: {verdict['requests']} "
+                 f"({len(verdict['inflight'])} in flight)")
+    lines.append("")
+    lines.append("-- verdict " + "-" * 61)
+    lines.append(f"  {verdict['verdict']}")
+    if verdict["tail"]:
+        lines.append("")
+        lines.append(f"-- slowest {len(verdict['tail'])} request(s) "
+                     + "-" * 40)
+        hdr = (f"  {'request':<14}{'total':>9}  " +
+               "".join(f"{p:>12}" for p in PHASES) + "  dominant")
+        lines.append(hdr)
+        for r in verdict["tail"]:
+            phases = r.get("phase_ms") or {}
+            lines.append(
+                f"  {str(r['request_id']):<14}"
+                f"{r['total_ms']:>8.1f}ms" +
+                "".join(f"{phases.get(p, 0.0):>10.1f}ms"
+                        for p in PHASES) +
+                f"  {_dominant(r) or '-'}"
+                + ("  [in flight]" if r["inflight"] else ""))
+        lines.append("")
+        lines.append("  tail phase means (ms): " + "  ".join(
+            f"{p}={v:g}" for p, v in verdict["phase_mean_ms"].items()))
+    lines.append("")
+    return "\n".join(lines)
+
+
+# -- Perfetto export: the slot timeline -------------------------------------
+
+def slot_trace(dumps):
+    """Chrome/Perfetto trace of the serving timeline: one pid per rank;
+    lane 0 = admission queue (queue_wait spans), lane 1 = engine
+    (decode_tick + heartbeat), lanes 2+ = one per batch slot (prefill +
+    decode residency, named by the slot attr). Open spans at dump time
+    render as instants — the in-flight work a failover killed."""
+    events = []
+    serve_stages = set(hvd_tracing.SERVE_STAGES)
+    for d in dumps:
+        rank = d.get("rank")
+        pid = rank if rank is not None else 999
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"hvd serve rank {rank}"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": "queue"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": "engine"}})
+        slots_seen = set()
+
+        def lane(span):
+            stage = span.get("stage")
+            if stage in (hvd_tracing.QUEUE_WAIT, hvd_tracing.REQUEST):
+                return 0
+            if stage in (hvd_tracing.DECODE_TICK,
+                         hvd_tracing.HEARTBEAT):
+                return 1
+            slot = (span.get("attrs") or {}).get("slot")
+            if slot is None:
+                return 1
+            if slot not in slots_seen:
+                slots_seen.add(slot)
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": 2 + slot,
+                               "args": {"name": f"slot {slot}"}})
+            return 2 + slot
+
+        for s in d.get("spans", []):
+            if s.get("stage") not in serve_stages or \
+                    s.get("t1_us") is None:
+                continue
+            events.append({
+                "name": s.get("tensor") or s.get("stage"),
+                "cat": s.get("stage"), "ph": "X", "ts": s["t0_us"],
+                "dur": max(s["t1_us"] - s["t0_us"], 1), "pid": pid,
+                "tid": lane(s),
+                "args": {"trace_id": s.get("trace_id"),
+                         "status": s.get("status"),
+                         **(s.get("attrs") or {})}})
+        for s in d.get("open_spans", []):
+            if s.get("stage") not in serve_stages:
+                continue
+            events.append({
+                "name": f"OPEN {s.get('tensor') or s.get('stage')}",
+                "cat": "open", "ph": "i", "s": "p",
+                "ts": s.get("t0_us", 0), "pid": pid, "tid": lane(s),
+                "args": {"trace_id": s.get("trace_id")}})
+        for e in d.get("events", []):
+            if e.get("event") in ("serve_failover", "serve_reject",
+                                  "slow_decode_tick"):
+                events.append({
+                    "name": e["event"], "cat": "event", "ph": "i",
+                    "s": "g", "ts": e.get("t_us", 0), "pid": pid,
+                    "tid": 1,
+                    "args": {k: v for k, v in e.items()
+                             if k not in ("ts_us", "epoch_us", "t_us")}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- selftest ---------------------------------------------------------------
+
+class _FakeUsClock:
+    """Deterministic microsecond clock for synthetic traces."""
+
+    def __init__(self):
+        self.now_us = 0.0
+        self.epoch_us_at_ts0 = 1_700_000_000_000_000
+
+    def ts_us(self):
+        return self.now_us
+
+    def epoch_us(self, ts_us=None):
+        return self.epoch_us_at_ts0 + (
+            self.now_us if ts_us is None else ts_us)
+
+
+def _synthetic_dump(slow_phase):
+    """Build one rank's flight dump from a real Tracer fed synthetic
+    request lifecycles: 9 fast requests plus 3 whose ``slow_phase``
+    (queue_wait-with-requeues, or prefill) is 100x slower."""
+    from horovod_tpu.serving import tracing as serve_tracing
+
+    clock = _FakeUsClock()
+    tracer = hvd_tracing.Tracer(rank=0, clock=clock)
+
+    def one_request(rid, queue_ms, prefill_ms, decode_ms, requeues=0):
+        trace = serve_tracing.RequestTrace(tracer, rid).on_submit()
+        clock.now_us += queue_ms * 1e3
+        trace.on_pop()
+        for _ in range(requeues):
+            trace.on_requeue()
+            clock.now_us += queue_ms * 1e3
+            trace.on_pop()
+        trace.on_prefill_start(slot=0, prompt_len=4)
+        clock.now_us += prefill_ms * 1e3
+        trace.on_prefill_end(ttft_s=0.01)
+        clock.now_us += decode_ms * 1e3
+        trace.on_decode_tick(decode_ms * 1e3)
+        trace.on_retire("completed", tokens=8)
+
+    for i in range(9):
+        one_request(f"fast-{i}", 1.0, 2.0, 10.0)
+    for i in range(3):
+        if slow_phase == "queue_wait":
+            one_request(f"slow-{i}", 200.0, 2.0, 10.0, requeues=3)
+        else:
+            one_request(f"slow-{i}", 1.0, 400.0, 10.0)
+    return tracer.flight_snapshot(f"selftest-{slow_phase}")
+
+
+def selftest():
+    """Two synthetic tails, each verdict must name the injected phase."""
+    kv = analyze_serve([_synthetic_dump("queue_wait")])
+    assert kv["requests"] == 12, kv
+    assert kv["dominant_phase"] in ("queue_wait", "requeue"), kv
+    assert kv["kv_pressure"], kv
+    assert "KV pressure" in kv["verdict"], kv
+
+    pf = analyze_serve([_synthetic_dump("prefill")])
+    assert pf["dominant_phase"] == "prefill", pf
+    assert not pf["kv_pressure"], pf
+
+    # the report and the trace must render without error
+    dumps = [_synthetic_dump("queue_wait")]
+    hvd_postmortem.rebase(dumps)
+    report = render_report(dumps, analyze_serve(dumps))
+    assert "dominated by" in report
+    trace = slot_trace(dumps)
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+    print("hvd_slo --selftest: ok "
+          f"(kv verdict: {kv['verdict']!r}; "
+          f"prefill verdict: {pf['verdict']!r})")
+    return 0
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="*",
+                    help="flight dump files (default: all flight-rank*."
+                         "json under --dir)")
+    ap.add_argument("--dir", default=None,
+                    help="directory to scan for dumps (default: "
+                         "HVD_FLIGHT_DIR)")
+    ap.add_argument("--pct", type=float, default=None,
+                    help="tail percentile to attribute (default: "
+                         "HVD_SLO_PCT or 90)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict as JSON instead of the "
+                         "report")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="also write the Perfetto slot timeline here")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the report here instead of stdout")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in synthetic-tail checks")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+
+    paths = args.dumps or hvd_postmortem.find_dumps(args.dir)
+    if not paths:
+        print("hvd_slo: no flight dumps found (looked in "
+              f"{args.dir or hvd_tracing.flight_dir()})", file=sys.stderr)
+        return 2
+    dumps, bad = hvd_postmortem.load_dumps(paths)
+    if not dumps:
+        for path, why in bad:
+            print(f"hvd_slo: unreadable dump {path}: {why}",
+                  file=sys.stderr)
+        return 2
+    hvd_postmortem.rebase(dumps)
+    verdict = analyze_serve(dumps, pct=args.pct)
+
+    if args.trace:
+        trace = slot_trace(dumps)
+        with open(args.trace, "w") as f:
+            json.dump(trace, f)
+        print(f"hvd_slo: wrote {len(trace['traceEvents'])} trace events "
+              f"to {args.trace}", file=sys.stderr)
+
+    if args.json:
+        text = json.dumps(verdict, indent=2, sort_keys=True)
+    else:
+        text = render_report(dumps, verdict)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

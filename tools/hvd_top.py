@@ -435,6 +435,40 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
                 lines.append(f"    {label:<13} mean {_fmt_s(hsum / hcount):>8}"
                              f"   p50 {_fmt_s(hp50):>8}   "
                              f"p99 {_fmt_s(hp99):>8}")
+        # SLO goodput: deadline-met vs wasted tokens (serving/tracing.py)
+        good = _total(snap, "hvd_serve_goodput_tokens_total")
+        wasted = _by_label(snap, "hvd_serve_wasted_tokens_total",
+                           "reason")
+        if good or wasted:
+            ratio = good / max(good + sum(wasted.values()), 1.0)
+            waste_s = "  ".join(
+                f"{k}={int(v):,}" for k, v in sorted(wasted.items()))
+            gp_line = (f"    goodput       tokens {int(good):>10,}   "
+                       f"ratio {ratio:>6.1%}   "
+                       f"wasted {waste_s or '0'}")
+            lines.append(c(YELLOW, gp_line) if wasted else gp_line)
+        # per-request phase decomposition (hvd_serve_phase_seconds):
+        # where the p99 request actually spent its life — the live view
+        # of what tools/hvd_slo.py reconstructs from a flight dump
+        ph = snap.get("metrics", {}).get("hvd_serve_phase_seconds")
+        if ph and ph.get("values"):
+            bounds = ph.get("buckets", [])
+            by_phase = {v.get("labels", {}).get("phase", "?"): v
+                        for v in ph["values"]}
+            order = ("queue_wait", "requeue", "prefill", "decode",
+                     "scheduler_stall")
+            for phase in [p for p in order if p in by_phase] + sorted(
+                    p for p in by_phase if p not in order):
+                v = by_phase[phase]
+                counts = v.get("counts", [])
+                pp50 = hvd_metrics.histogram_quantile(bounds, counts,
+                                                      0.5)
+                pp99 = hvd_metrics.histogram_quantile(bounds, counts,
+                                                      0.99)
+                lines.append(f"    {phase:<13} reqs "
+                             f"{v.get('count', 0):>10,}   "
+                             f"p50 {_fmt_s(pp50):>8}   "
+                             f"p99 {_fmt_s(pp99):>8}")
 
     # tracing plane: per-stage span latency + the slow-span tail
     span_entry = snap.get("metrics", {}).get("hvd_span_seconds")
@@ -615,11 +649,27 @@ def canned_snapshot():
     for v in (0.004, 0.006, 0.011):
         for _ in range(200):
             it.observe(v)
+    reg.counter("hvd_serve_goodput_tokens_total", "c").inc(84_300)
+    sw = reg.counter("hvd_serve_wasted_tokens_total", "c",
+                     labels=("reason",))
+    sw.labels(reason="deadline").inc(5_100)
+    sw.labels(reason="kv_exhausted").inc(1_300)
+    reg.gauge("hvd_serve_goodput_ratio", "g").set(0.929)
+    ph = reg.histogram("hvd_serve_phase_seconds", "h",
+                       labels=("phase",),
+                       buckets=hvd_metrics.SERVE_PHASE_BUCKETS)
+    for phase, v in (("queue_wait", 0.03), ("requeue", 0.002),
+                     ("prefill", 0.02), ("decode", 0.12),
+                     ("scheduler_stall", 0.004)):
+        for _ in range(60):
+            ph.labels(phase=phase).observe(v)
     reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",
               trace_id="r1.42", dur_ms=412.5, status="ok")
     reg.event("serve_reject", request_id="req-9917", reason="queue_full",
-              waited_s=0.0)
-    reg.event("serve_failover", lost_ranks=[1])
+              trace_id="r0.917", waited_s=0.0)
+    reg.event("serve_failover", lost_ranks=[1],
+              inflight=["req-9810", "req-9811"])
+    reg.event("slow_decode_tick", active=6, dur_ms=312.0)
     reg.event("stall", tensor="grad/dense_7", missing_ranks=[3],
               waited_s=61.2, trace_id="r1.42")
     reg.event("chaos_injection", fault="drop_response",

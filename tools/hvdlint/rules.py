@@ -1093,6 +1093,53 @@ def check_adhoc_step_timer(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD014 — ad-hoc per-request timing outside the request-trace layer
+# ---------------------------------------------------------------------------
+
+# serving/tracing.py is the one sanctioned place for request timing;
+# everywhere else in the serving plane a clock delta against a request
+# timestamp is a rival latency story
+_SERVE_DIR = "horovod_tpu/serving/"
+_SERVE_TRACE_LAYER = "serving/tracing.py"
+# request-lifecycle timestamp attributes: subtracting one measures a
+# request phase
+_REQUEST_TS_ATTRS = {"arrival_ts", "last_token_ts", "finish_ts"}
+
+
+def check_adhoc_request_timer(ctx, shared):
+    if "serve_path" not in ctx.roles and not (
+            _SERVE_DIR in ctx.relpath and
+            not ctx.relpath.endswith(_SERVE_TRACE_LAYER)):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.BinOp) and
+                isinstance(node.op, ast.Sub)):
+            continue
+        attr = next((side.attr for side in (node.left, node.right)
+                     if isinstance(side, ast.Attribute) and
+                     side.attr in _REQUEST_TS_ATTRS), None)
+        if attr is None:
+            continue
+        yield Finding(
+            "HVD014", ctx.relpath, node.lineno, node.col_offset,
+            f"ad-hoc per-request timer in the serving plane: a clock "
+            f"delta against a request timestamp ({attr}) measures a "
+            f"phase the request-trace layer already accounts. "
+            "serving/tracing.py is the one sanctioned place for "
+            "request timing — it publishes the queue_wait/requeue/"
+            "prefill/decode/scheduler_stall decomposition to the "
+            "flight recorder, hvd_serve_phase_seconds, and the "
+            "hvd_slo tail analyzer. A second stopwatch here produces "
+            "a latency number with different boundaries (no requeue "
+            "credit, no stall residual) that never reaches the tail "
+            "report, and the two numbers get debugged against each "
+            "other. Route the measurement through RequestTrace or "
+            "annotate its spans; keep a local delta only with a "
+            "disable reason naming the SLO instrument that consumes "
+            "it.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1446,5 +1493,41 @@ pass ``name=`` to keep loops distinct); for durations that feed a
 histogram on the shared registry, keep the timer and add a disable
 reason saying which instrument consumes it.""",
             check_adhoc_step_timer),
+        Rule(
+            "HVD014", "adhoc-request-timer",
+            "raw clock deltas on request timestamps outside the "
+            "request-trace layer",
+            """HVD014 — ad-hoc per-request timing outside serving/tracing.py
+
+The serving plane gives request latency exactly one front door:
+``serving/tracing.py``. Every admitted ``Request`` is one trace whose
+phase decomposition (queue_wait / requeue / prefill / decode /
+scheduler_stall, in ms) lands in the root span's attrs, the
+``hvd_serve_phase_seconds`` histogram, the serve_retire event, and the
+``RequestResult`` — which is what tools/hvd_slo.py attributes the tail
+from and what hvd_top renders live.
+
+A stray ``now - request.arrival_ts`` anywhere else in
+``horovod_tpu/serving/`` starts a second, unpublished latency story
+for the "same" request — usually with different boundaries: it
+ignores requeue credit, folds scheduler stall into whatever phase it
+thinks it is measuring, and never reaches the tail analyzer. The
+historical shape: a p99 chased for a day because an ad-hoc TTFT
+number disagreed with the trace's prefill phase by the admission
+wait.
+
+Flags binary subtractions where either operand is an attribute access
+on a request-lifecycle timestamp (``arrival_ts``, ``last_token_ts``,
+``finish_ts``) in ``horovod_tpu/serving/`` — except in
+``serving/tracing.py`` itself, the sanctioned layer. Fixtures opt in
+with ``# hvdlint: role=serve_path``.
+
+Fix: drive the measurement through ``RequestTrace`` (on_pop /
+on_prefill_end / on_decode_tick / on_retire already stamp every
+phase) or annotate its spans; keep a local delta only with a disable
+reason naming the SLO instrument on the shared registry that consumes
+it (the engine's TTFT/intertoken histograms and the deadline checks
+are the baselined examples).""",
+            check_adhoc_request_timer),
     ]
 }
