@@ -801,6 +801,134 @@ def _bench_serve(on_tpu):
     return out
 
 
+def _bench_swap(on_tpu):
+    """Hot-swap overhead gate (docs/fleet.md): the SAME Poisson open-loop
+    serve workload twice — once plain, once with a WeightSubscriber
+    attached and a new weight generation published mid-traffic so the
+    engine swaps params while requests are in flight. Enforced
+    (AssertionError): the swap arm's decode tokens per device step must
+    stay within HVD_BENCH_SWAP_DIP_PCT (default 5%) of the no-swap arm,
+    its p99 decode-step wall must stay within HVD_BENCH_SWAP_P99_X
+    (default 3x) of the no-swap p99 — i.e. the background load never
+    blocks the decode loop — and at least one swap must actually land.
+    The swap's phase latency decomposition (engine.last_swap) rides
+    along in the JSON for the perf ledger."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"))
+    from serve_lm import make_workload, serving_config
+    from horovod_tpu.fleet import WeightPublisher, WeightSubscriber
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.serving import AdmissionQueue, ServeEngine
+    from horovod_tpu.utils import checkpoint as hvd_checkpoint
+
+    cfg = serving_config(on_tpu)
+    _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    slots, max_len, kv_block = 4, 64, 8
+    n_requests = 96 if on_tpu else 48
+    dip_budget = float(os.environ.get("HVD_BENCH_SWAP_DIP_PCT", "5.0"))
+    p99_budget_x = float(os.environ.get("HVD_BENCH_SWAP_P99_X", "3.0"))
+
+    def run_arm(workload, subscriber=None, publish=None, publish_after=0):
+        """Drive the workload; if publishing, commit the next generation
+        once ``publish_after`` requests have retired. Returns (tokens per
+        step, p99 decode-step wall seconds, engine)."""
+        queue = AdmissionQueue(max_depth=len(workload) + 1,
+                               admission_timeout_s=1e9)
+        eng = ServeEngine(cfg, params, num_slots=slots, max_len=max_len,
+                          kv_block=kv_block, queue=queue, seed=0,
+                          subscriber=subscriber)
+        i = steps = done = 0
+        published = False
+        step_walls = []
+        while i < len(workload) or eng.active_count or len(eng.queue):
+            while i < len(workload) and workload[i][0] <= steps:
+                eng.submit(workload[i][1])
+                i += 1
+            busy = eng.active_count > 0
+            # hvdlint: disable=HVD013(bench harness: p99 decode-step wall is this sub-gate's reported number)
+            t0 = time.perf_counter()
+            done += len(eng.step())
+            if busy:
+                # hvdlint: disable=HVD013(bench harness: p99 decode-step wall is this sub-gate's reported number)
+                step_walls.append(time.perf_counter() - t0)
+            steps += 1
+            if publish is not None and not published and \
+                    done >= publish_after:
+                publish()
+                published = True
+        if subscriber is not None and eng.generation == 1:
+            # load still in flight when traffic drained: absorb it so
+            # the >=1-swap gate measures the mechanism, not the draw of
+            # arrival timing on this host
+            subscriber.wait(timeout=30.0)
+            eng.step()
+        walls = sorted(step_walls)
+        p99 = walls[min(len(walls) - 1, int(0.99 * len(walls)))] \
+            if walls else 0.0
+        return steps, p99, eng
+
+    def summarize(workload, steps):
+        total = sum(w[1].max_new_tokens for w in workload)
+        return total / max(steps, 1)
+
+    # untimed warmup compiles every prefill pad variant + decode step
+    warm = make_workload(seed=7, n_requests=6, rate=1.0)
+    run_arm(warm)
+
+    workload = make_workload(seed=0, n_requests=n_requests, rate=0.5)
+    base_steps, base_p99, _ = run_arm(workload)
+    base_tps = summarize(workload, base_steps)
+
+    tmp = tempfile.mkdtemp(prefix="hvd-bench-swap-")
+    try:
+        mgr = hvd_checkpoint.CheckpointManager(tmp, rank=0, world_size=1,
+                                               async_save=False)
+        pub = WeightPublisher(tmp)
+        mgr.on_commit = pub.publish
+        mgr.save(params, step=0, block=True)
+        sub = WeightSubscriber(tmp, like=params, poll_interval_s=0.0)
+        sub.load_initial()
+        params1 = jax.tree_util.tree_map(lambda x: x * 1.0001, params)
+        swap_steps, swap_p99, eng = run_arm(
+            workload, subscriber=sub,
+            publish=lambda: mgr.save(params1, step=1, block=True),
+            publish_after=max(1, n_requests // 4))
+        mgr.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    swap_tps = summarize(workload, swap_steps)
+
+    dip_pct = (base_tps - swap_tps) / max(base_tps, 1e-9) * 100.0
+    out = {
+        "requests": n_requests,
+        "tokens_per_step": round(swap_tps, 3),
+        "baseline_tokens_per_step": round(base_tps, 3),
+        "dip_pct": round(dip_pct, 2),
+        "dip_budget_pct": dip_budget,
+        "p99_step_ms": round(swap_p99 * 1e3, 3),
+        "baseline_p99_step_ms": round(base_p99 * 1e3, 3),
+        "p99_budget_x": p99_budget_x,
+        "swaps": int(eng.generation > 1),
+        "swap_latency_ms": eng.last_swap,
+    }
+    assert eng.generation > 1, (
+        f"no swap landed during the traffic window: {out}")
+    assert dip_pct <= dip_budget, (
+        f"hot swap cost {dip_pct:.2f}% tokens/step, over the "
+        f"{dip_budget}% budget: {out}")
+    assert swap_p99 <= base_p99 * p99_budget_x + 1e-9, (
+        f"swap-arm p99 step wall {swap_p99 * 1e3:.3f}ms exceeds "
+        f"{p99_budget_x}x the no-swap p99 "
+        f"{base_p99 * 1e3:.3f}ms: {out}")
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -1082,6 +1210,12 @@ def main():
     serve = None
     if os.environ.get("HVD_BENCH_SERVE", "") != "0":
         serve = _bench_serve(on_tpu)
+    # Fleet-plane hot-swap gate: mid-traffic weight publication must
+    # cost <=5% tokens/step and never block the decode loop (p99 step
+    # wall bound); ENFORCED (AssertionError). HVD_BENCH_SWAP=0 skips it.
+    swap = None
+    if os.environ.get("HVD_BENCH_SWAP", "") != "0":
+        swap = _bench_swap(on_tpu)
     # Checkpoint-plane overhead gate: async double-buffered saves every
     # step vs no checkpointing around a calibrated training-shaped
     # step; the <=2% budget is ENFORCED (AssertionError), the
@@ -1263,6 +1397,7 @@ def main():
         "numerics": numerics,
         "quant": quant,
         "serve": serve,
+        "swap": swap,
         "ckpt": ckpt,
         "perf_attrib": perf_attrib,
         "metrics": metrics_snap,

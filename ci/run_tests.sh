@@ -91,6 +91,18 @@ echo "--- checkpoint plane (fast fail: commit protocol, torture matrix, reshard)
 # drills ride test_chaos_plane.py with the other drills.
 python -m pytest tests/test_checkpoint.py -q -m "not slow"
 
+echo "--- fleet plane (fast fail: publication pointer, hot-swap parity, refusal)"
+# The fleet plane (docs/fleet.md) is the train->serve weight path:
+# every checkpoint commit becomes a published generation, replicas
+# background-load and swap at a step boundary with zero drain. The
+# suite proves the pointer protocol (GC-race tolerant), temp-0 parity
+# across a mid-stream swap, and loud refusal of corrupt publishes; the
+# selftest round-trips publish->subscribe->arm->take single-process.
+# The full drill (preempted trainer + replica loss + swaps under
+# traffic) rides test_chaos_plane.py with the other drills.
+python -m pytest tests/test_fleet.py -q -m "not slow"
+python tools/hvd_fleet.py --selftest
+
 echo "--- perf attribution (fast fail: overlap math, roofline model, regression ledger)"
 # The perf-attribution plane (docs/profiling.md) is how every other
 # plane's "is it fast enough" question gets answered: trace

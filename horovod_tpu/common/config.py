@@ -248,6 +248,17 @@ ENV_REGISTRY = (
      "contact (0 disables)."),
     ("HOROVOD_CYCLE_TIME", True, "5.0", "common/config.py",
      "Negotiation cycle time in milliseconds."),
+    ("HOROVOD_FLEET_POLL_S", True, "0.5", "fleet/subscriber.py",
+     "Fleet plane: seconds between publication-pointer polls by a "
+     "serving replica's WeightSubscriber (the fast path is one stat)."),
+    ("HOROVOD_FLEET_PUBLISH", True, "0", "trainer.py",
+     "Fleet plane: publish every committed checkpoint as a weight "
+     "generation (trainer.Checkpointer attaches a WeightPublisher on "
+     "rank 0)."),
+    ("HOROVOD_FLEET_VERIFY", True, "1", "fleet/subscriber.py",
+     "Fleet plane: checksum-verify a published generation's files "
+     "before arming it for a hot swap (0 trusts the manifest; corrupt "
+     "weights would reach decode)."),
     ("HOROVOD_FLIGHT_CYCLES", True, "64", "utils/tracing.py",
      "Flight-recorder ring size for negotiation-cycle records."),
     ("HOROVOD_FLIGHT_DIR", True, None, "utils/tracing.py",
@@ -443,6 +454,16 @@ ENV_REGISTRY = (
     ("HVD_BENCH_SERVE_TRACE", False, None, "bench.py",
      "Set 0 to skip the request-tracing overhead sub-gate of the "
      "serving bench leg (tracing on vs off <=2% wall per step)."),
+    ("HVD_BENCH_SWAP", False, None, "bench.py",
+     "Set 0 to skip the weight hot-swap sub-gate of the serving bench "
+     "leg (mid-traffic swap must hold tokens/step and p99 inter-token "
+     "vs a no-swap baseline; reports detect->swapped latency)."),
+    ("HVD_BENCH_SWAP_DIP_PCT", False, "5.0", "bench.py",
+     "Max decode tokens/step dip (percent) the swap arm may show vs "
+     "the no-swap baseline in the HVD_BENCH_SWAP gate."),
+    ("HVD_BENCH_SWAP_P99_X", False, "3.0", "bench.py",
+     "Max p99 inter-token multiple vs the no-swap baseline in the "
+     "HVD_BENCH_SWAP gate (headroom for CPU-host scheduling noise)."),
     ("HVD_SLO_PCT", False, "90", "tools/hvd_slo.py",
      "Tail percentile the hvd_slo analyzer attributes (the slowest "
      "(100-pct)% of completed requests form the tail)."),

@@ -1,0 +1,32 @@
+"""Fleet plane: train->serve weight publication (docs/fleet.md).
+
+Closes the loop between the checkpoint plane (docs/checkpoint.md) and
+the serving plane (docs/serving.md): a ``WeightPublisher`` on the
+trainer side turns every atomic manifest commit into a published weight
+generation (monotonic generation id + step + checksum set, carried by a
+single atomically-renamed publication pointer), and a
+``WeightSubscriber`` on each serving replica watches the pointer,
+background-loads new generations off the decode hot path, checksum-
+verifies before arming, and hands fully-loaded trees to the
+``ServeEngine`` for a zero-drain swap at a step boundary.
+
+Imports are lazy for the same reason serving/__init__.py's are: the
+subscriber pulls in the checkpoint plane (and through it jax), which
+process-launch helpers must not pay for.
+"""
+
+_LAZY = {
+    "WeightPublisher": "publisher",
+    "WeightSubscriber": "subscriber",
+    "ArmedGeneration": "subscriber",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -70,15 +70,19 @@ class _Active:
     """Host-side per-slot decode state."""
 
     __slots__ = ("request", "generated", "next_token", "next_pos",
-                 "last_token_ts", "ttft_s")
+                 "last_token_ts", "ttft_s", "generation")
 
-    def __init__(self, request, first_token, prompt_len, now):
+    def __init__(self, request, first_token, prompt_len, now,
+                 generation=0):
         self.request = request
         self.generated = [first_token]
         self.next_token = first_token  # fed to the next decode step
         self.next_pos = prompt_len  # cache position it will occupy
         self.last_token_ts = now
         self.ttft_s = now - request.arrival_ts
+        # weight generation that admitted this request: it decodes on
+        # these weights to the end, across any hot swap (docs/fleet.md)
+        self.generation = generation
 
 
 class ServeEngine:
@@ -95,9 +99,25 @@ class ServeEngine:
     def __init__(self, cfg, params, num_slots=None, max_len=None,
                  kv_block=None, total_blocks=None, policy="continuous",
                  queue=None, seed=0, replica=None, on_ranks_lost=None,
-                 clock=time.monotonic):
+                 subscriber=None, generation=None, clock=time.monotonic):
         self.cfg = cfg
         self.params = params
+        # fleet plane (docs/fleet.md): the subscriber feeds armed weight
+        # generations; swaps happen at step boundaries in _maybe_swap.
+        # params is always the CURRENT generation's tree (what prefill
+        # uses); _params_by_gen keeps older generations alive exactly as
+        # long as a request admitted under them is still decoding.
+        if subscriber is None and replica is not None:
+            subscriber = getattr(replica, "subscriber", None)
+        self._subscriber = subscriber
+        if generation is None:
+            generation = 0
+            if subscriber is not None and \
+                    subscriber.current_generation is not None:
+                generation = subscriber.current_generation
+        self._generation = int(generation)
+        self._params_by_gen = {self._generation: params}
+        self.last_swap = None  # latency phases of the most recent swap
         num_slots = (config.env_int("SERVE_SLOTS", 8)
                      if num_slots is None else num_slots)
         self.kv = KVCache(cfg, num_slots, max_len=max_len,
@@ -152,6 +172,25 @@ class ServeEngine:
             "life; 1.0 until the first wasted token.")
         self._goodput_tokens = 0
         self._wasted_tokens = 0
+        if self._subscriber is not None:
+            rep = str(self._subscriber.replica)
+            self._m_gen = reg.gauge(
+                "hvd_fleet_generation",
+                "Weight generation this replica is currently serving.",
+                labels=("replica",)).labels(replica=rep)
+            self._m_gen.set(self._generation)
+            self._m_swaps = reg.counter(
+                "hvd_fleet_swaps_total",
+                "Zero-drain weight swaps completed by serving engines.")
+            self._m_last_swap = reg.gauge(
+                "hvd_fleet_last_swap_seconds",
+                "Detect->swapped latency of this replica's most recent "
+                "weight swap.", labels=("replica",)).labels(replica=rep)
+            self._m_swap_s = reg.histogram(
+                "hvd_fleet_swap_seconds",
+                "Weight-swap latency decomposition "
+                "(detect_to_loaded/loaded_to_armed/armed_to_swapped/"
+                "total).", labels=("phase",))
         serve_tracing.phase_histogram(reg)
         self._gauge_interval = config.env_float(
             "SERVE_METRICS_INTERVAL_S", 1.0)
@@ -168,6 +207,7 @@ class ServeEngine:
         """One scheduler iteration. Returns the requests that finished
         during it (as RequestResults, also kept on self.results)."""
         self._heartbeat()
+        self._maybe_swap()
         dirty = self._admit()
         self.scheduler.begin_wave()
         dirty |= self._decode()
@@ -189,7 +229,65 @@ class ServeEngine:
     def active_count(self):
         return len(self._active)
 
+    @property
+    def generation(self):
+        """The weight generation newly admitted requests decode on."""
+        return self._generation
+
     # -- internals ------------------------------------------------------
+
+    def _maybe_swap(self):
+        """Zero-drain hot swap at the step boundary (docs/fleet.md):
+        poll the subscriber (cheap: one stat, rate-limited), and if a
+        fully loaded + verified generation is armed, make it current.
+        In-flight requests keep their admit-time generation — the
+        cohort decode in _decode() finishes them on the old weights —
+        so nothing drains and no half-loaded tree is ever visible."""
+        sub = self._subscriber
+        if sub is None:
+            return
+        sub.poll()
+        rec = sub.take_armed()
+        if rec is None:
+            return
+        old_gen, gen = self._generation, rec.generation
+        self.params = rec.params
+        self._params_by_gen[gen] = rec.params
+        self._generation = gen
+        self._prune_params()
+        now = sub.clock()  # the subscriber's clock stamped rec
+        d2l = max(rec.loaded_ts - rec.detect_ts, 0.0)
+        l2a = max(rec.armed_ts - rec.loaded_ts, 0.0)
+        a2s = max(now - rec.armed_ts, 0.0)
+        total = d2l + l2a + a2s
+        for phase, dt in (("detect_to_loaded", d2l),
+                          ("loaded_to_armed", l2a),
+                          ("armed_to_swapped", a2s), ("total", total)):
+            self._m_swap_s.labels(phase=phase).observe(dt)
+        self._m_swaps.inc()
+        self._m_gen.set(gen)
+        self._m_last_swap.set(total)
+        self.last_swap = {
+            "generation": gen, "from_generation": old_gen,
+            "step": rec.step,
+            "detect_to_loaded_ms": round(d2l * 1e3, 3),
+            "loaded_to_armed_ms": round(l2a * 1e3, 3),
+            "armed_to_swapped_ms": round(a2s * 1e3, 3),
+            "total_ms": round(total * 1e3, 3),
+        }
+        self._metrics.event(
+            "fleet_swap", replica=sub.replica,
+            inflight=len(self._active), **self.last_swap)
+
+    def _prune_params(self):
+        """Drop weight generations no active request decodes on. The
+        single-generation steady state short-circuits for free."""
+        if len(self._params_by_gen) == 1:
+            return
+        live = {st.generation for st in self._active.values()}
+        live.add(self._generation)
+        for gen in [g for g in self._params_by_gen if g not in live]:
+            del self._params_by_gen[gen]
 
     def _heartbeat(self):
         if self._replica is None:
@@ -237,7 +335,8 @@ class ServeEngine:
                 self._finished.append(RequestResult(
                     req.request_id, (), "failed", reason="too_long",
                     finish_ts=self._clock(), trace_id=trace.trace_id,
-                    phase_ms=phases or None))
+                    phase_ms=phases or None,
+                    generation=self._generation))
                 continue
             if not self.kv.ledger.can_alloc(final_len):
                 # cache pressure, not impossibility: wait for retirements.
@@ -269,14 +368,17 @@ class ServeEngine:
         # hvdlint: disable=HVD011(first-token sample is the prefill's output)
         first = int(jax.device_get(tok))
         now = self._clock()
-        self._active[slot] = _Active(req, first, prompt_len, now)
+        self._active[slot] = _Active(req, first, prompt_len, now,
+                                     generation=self._generation)
         trace.on_prefill_end(ttft_s=self._active[slot].ttft_s)
+        trace.annotate(generation=self._generation)
         self._m_tokens.labels(phase="prefill").inc(prompt_len)
         self._m_tokens.labels(phase="decode").inc()
         self._m_ttft.observe(self._active[slot].ttft_s)
         self._metrics.event("serve_admit", request_id=req.request_id,
                             slot=slot, prompt_len=prompt_len,
                             trace_id=trace.trace_id,
+                            generation=self._generation,
                             ttft_s=round(self._active[slot].ttft_s, 6))
         if req.max_new_tokens <= 1:
             self._retire(slot, "completed")
@@ -289,22 +391,40 @@ class ServeEngine:
         tick = serve_tracing.tick_span(**self.scheduler.snapshot())
         in_tick = list(self._active.values())
         S = self.kv.num_slots
-        tokens = np.zeros(S, np.int32)
-        positions = np.zeros(S, np.int32)
-        temps = np.zeros(S, np.float32)
+        # Cohort-partitioned decode (docs/fleet.md): a request decodes
+        # on the weights that admitted it, across any hot swap, so each
+        # live generation runs its own fused pass over ALL slots with
+        # its own params. Non-cohort rows park their K/V write at
+        # max_len-1, where the length mask hides the garbage until the
+        # row's own pass overwrites it with the real value — each pass
+        # writes then attends, so even a final-token write at max_len-1
+        # is read only after it lands. Between swaps there is exactly
+        # one cohort and this is the same single fused call as always.
+        cohorts = {}
         for slot, st in self._active.items():
-            tokens[slot] = st.next_token
-            positions[slot] = st.next_pos
-            temps[slot] = st.request.temperature
-        rng = jax.random.fold_in(self._rng, self._step_count)
-        self._step_count += 1
-        nxt, self.kv.k, self.kv.v = _decode_jit(
-            self.cfg, self.params, jnp.asarray(tokens),
-            jnp.asarray(positions), self.kv.k, self.kv.v,
-            jnp.asarray(temps), rng)
-        # the one sanctioned per-step readback: this step's sampled ids
-        # hvdlint: disable=HVD011(the per-step batched token readback)
-        sampled = np.asarray(jax.device_get(nxt))
+            cohorts.setdefault(st.generation, []).append(slot)
+        sampled = {}
+        for gen in sorted(cohorts):
+            tokens = np.zeros(S, np.int32)
+            positions = np.full(S, self.kv.max_len - 1, np.int32)
+            temps = np.zeros(S, np.float32)
+            for slot in cohorts[gen]:
+                st = self._active[slot]
+                tokens[slot] = st.next_token
+                positions[slot] = st.next_pos
+                temps[slot] = st.request.temperature
+            rng = jax.random.fold_in(self._rng, self._step_count)
+            self._step_count += 1
+            nxt, self.kv.k, self.kv.v = _decode_jit(
+                self.cfg, self._params_by_gen[gen], jnp.asarray(tokens),
+                jnp.asarray(positions), self.kv.k, self.kv.v,
+                jnp.asarray(temps), rng)
+            # the one sanctioned per-step readback (one per cohort
+            # during a swap transition): this pass's sampled ids
+            # hvdlint: disable=HVD011(the per-step batched token readback)
+            ids = np.asarray(jax.device_get(nxt))
+            for slot in cohorts[gen]:
+                sampled[slot] = int(ids[slot])
         tick_us = serve_tracing.finish_tick(tick,
                                             active_slots=len(in_tick))
         for st in in_tick:
@@ -316,7 +436,7 @@ class ServeEngine:
             if not self.kv.ledger.grow(slot, st.next_pos + 1):
                 self._retire(slot, "failed", reason="kv_exhausted")
                 continue
-            tok = int(sampled[slot])
+            tok = sampled[slot]
             st.generated.append(tok)
             st.next_token = tok
             st.next_pos += 1
@@ -363,11 +483,14 @@ class ServeEngine:
                             request_id=req.request_id, slot=slot,
                             outcome=outcome, reason=reason,
                             tokens=len(st.generated),
+                            generation=st.generation,
                             trace_id=trace.trace_id)
         self._finished.append(RequestResult(
             req.request_id, tuple(st.generated), outcome,
             ttft_s=st.ttft_s, finish_ts=now, reason=reason,
-            trace_id=trace.trace_id, phase_ms=phases or None))
+            trace_id=trace.trace_id, phase_ms=phases or None,
+            generation=st.generation))
+        self._prune_params()
 
     def _refresh_gauges(self, force=False):
         now = self._clock()

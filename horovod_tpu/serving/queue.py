@@ -57,6 +57,7 @@ class RequestResult:
     reason: str = ""  # detail for outcome=failed
     trace_id: Optional[str] = None  # the request's trace (tracing.py)
     phase_ms: Optional[dict] = None  # latency decomposition by phase
+    generation: Optional[int] = None  # weight generation that decoded it
 
 
 class AdmissionQueue:

@@ -164,6 +164,14 @@ class RequestTrace:
         tick's duration to its decode phase."""
         self._phase_us["decode"] += dur_us
 
+    def annotate(self, **attrs):
+        """Stamp attrs on the request's root span — e.g. the weight
+        generation that admitted it (fleet plane, docs/fleet.md), so
+        every flight dump attributes tokens to the weights that
+        produced them."""
+        if self.root is not None:
+            self.root.annotate(**attrs)
+
     def on_retire(self, outcome, reason="", tokens=0):
         if self._decode is not None:
             self._decode.annotate(tokens=tokens)
@@ -227,6 +235,9 @@ class _NullRequestTrace:
         pass
 
     def on_decode_tick(self, dur_us):
+        pass
+
+    def annotate(self, **attrs):
         pass
 
     def on_retire(self, outcome, reason="", tokens=0):

@@ -229,11 +229,23 @@ class Checkpointer:
 
     def __init__(self, directory, every=None, keep=None, async_save=None,
                  preemption=None, rank=0, world_size=1, manager=None,
-                 verbose=False):
+                 verbose=False, publish=None):
         self.every = env_int("CKPT_EVERY", 0) if every is None else int(every)
         self.manager = manager or hvd_checkpoint.CheckpointManager(
             directory, rank=rank, world_size=world_size, keep=keep,
             async_save=async_save)
+        # fleet plane (docs/fleet.md): publish every commit as a weight
+        # generation serving replicas can hot-swap to. The publisher
+        # recovers its generation counter from the existing pointer, so
+        # a preempted-and-restarted trainer keeps publishing monotonic
+        # ids. Rank 0 only — that is the rank whose writer commits.
+        if publish is None:
+            publish = env_bool("FLEET_PUBLISH", False)
+        self.publisher = None
+        if publish and self.manager.rank == 0:
+            from .fleet import WeightPublisher
+            self.publisher = WeightPublisher(self.manager.directory)
+            self.manager.on_commit = self.publisher.publish
         self.verbose = verbose
         self._preempt = threading.Event()
         self._signals = []

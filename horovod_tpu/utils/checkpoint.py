@@ -218,11 +218,15 @@ def save(path, tree, step=0, force_all_processes=False):
 
 
 def _legacy_dir(path):
-    """The directory actually holding a format-1 manifest: ``path``, or
+    """The directory actually holding a format-1 checkpoint: ``path``, or
     ``path + ".old"`` when a crash interrupted an overwrite mid-rename.
-    None when neither exists."""
+    None when neither exists. Requires the arrays archive alongside the
+    manifest so a format-2 publication pointer (a top-level
+    ``manifest.json`` naming the newest committed step — see
+    ``latest_manifest``) is never mistaken for a legacy checkpoint."""
     for p in (path, path + ".old"):
-        if os.path.exists(os.path.join(p, _MANIFEST)):
+        if os.path.exists(os.path.join(p, _MANIFEST)) and \
+                os.path.exists(os.path.join(p, _ARRAYS)):
             return p
     return None
 
@@ -428,6 +432,86 @@ def latest_step(path):
 
 
 # ---------------------------------------------------------------------------
+# publication pointer (the fleet plane's watch primitive — docs/fleet.md)
+# ---------------------------------------------------------------------------
+#
+# A top-level <path>/manifest.json holding a copy of the newest committed
+# step's global manifest plus {"generation", "dir"}. It is written by the
+# fleet plane's WeightPublisher via _write_atomic AFTER the step commit
+# and BEFORE retention GC runs, which is what makes polling race-free: by
+# the time an old step directory can vanish, the pointer already names
+# its replacement. Pollers stat/read ONE file instead of scanning the
+# directory.
+
+def manifest_signature(path):
+    """Cheap change detector for ``latest_manifest`` polling: one stat
+    of the publication pointer -> (mtime_ns, size), or None when no
+    pointer exists (pre-fleet checkpoint directory, or nothing saved
+    yet). Atomic rename replaces the inode, so any republish changes
+    the signature even when sizes collide."""
+    try:
+        st = os.stat(os.path.join(path, _MANIFEST))
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+def write_pointer(path, pointer):
+    """Atomically publish ``pointer`` (a global manifest dict extended
+    with generation/dir) as <path>/manifest.json."""
+    payload = json.dumps(pointer).encode()
+    _write_atomic(os.path.join(path, _MANIFEST),
+                  lambda f: f.write(payload))
+    _fsync_dir(path)
+
+
+def latest_manifest(path, retries=3):
+    """Newest committed global manifest under ``path`` without a
+    directory scan -> (step, step_dir, manifest) or None.
+
+    Fast path: read the publication pointer (one file). Fallback for
+    directories no publisher ever touched: the ``_committed_steps``
+    scan, retried when GC unlinks a manifest between the listdir and
+    the read — the TOCTOU window a poller would otherwise hit between
+    GC unlink and re-commit. A half-replaced pointer can never be
+    observed (``os.replace`` is atomic), but a *stale* one — pointing
+    at a step GC already removed, possible only if the publisher died
+    between commit and publish — falls back to the scan too.
+    """
+    pointer = os.path.join(path, _MANIFEST)
+    doc = None
+    try:
+        with open(pointer) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        doc = None
+    except (OSError, ValueError):
+        # mid-read inode swap on a non-atomic-visibility filesystem, or
+        # a torn pointer from a pre-_write_atomic crash: treat as absent
+        doc = None
+    if isinstance(doc, dict) and doc.get("format") == CHECKPOINT_FORMAT \
+            and "dir" in doc:
+        d = os.path.join(path, str(doc["dir"]))
+        if os.path.exists(os.path.join(d, _MANIFEST)):
+            return int(doc["step"]), d, doc
+    elif isinstance(doc, dict) and "dir" not in doc:
+        return None  # a format-1 checkpoint lives AT path — no steps
+    for _ in range(max(1, int(retries))):
+        steps = _committed_steps(path)
+        if not steps:
+            return None
+        step = max(steps)
+        d = steps[step]
+        try:
+            return step, d, _read_global_manifest(d)
+        except CorruptCheckpointError as e:
+            if isinstance(e.__cause__, FileNotFoundError):
+                continue  # GC won the race for this step; rescan
+            raise
+    return None
+
+
+# ---------------------------------------------------------------------------
 # the checkpoint plane
 # ---------------------------------------------------------------------------
 
@@ -446,8 +530,15 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, rank=0, world_size=1, keep=None,
-                 async_save=None, shard=None, commit_timeout_s=120.0):
+                 async_save=None, shard=None, commit_timeout_s=120.0,
+                 on_commit=None):
         self.directory = directory
+        # rank-0 post-commit hook: on_commit(step, step_dir, manifest)
+        # runs on the writer thread after the manifest rename and BEFORE
+        # retention GC — the fleet plane's WeightPublisher hangs its
+        # publication pointer here (docs/fleet.md), which is what closes
+        # the poller's GC-unlink TOCTOU window.
+        self.on_commit = on_commit
         self.rank = int(rank)
         self.world_size = max(1, int(world_size))
         self.keep = env_int("CKPT_KEEP", 3) if keep is None else int(keep)
@@ -676,6 +767,8 @@ class CheckpointManager:
         _registry().event("ckpt_commit", step=step, save_kind=kind,
                           bytes=sum(m["bytes"] for m in files.values()),
                           ms=round(dt * 1e3, 3))
+        if self.on_commit is not None:
+            self.on_commit(step, d, manifest)
         self._gc()
         return d
 

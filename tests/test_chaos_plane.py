@@ -1100,3 +1100,303 @@ class TestDrillCheckpointRestart:
         expect = dict(_drill_trajectory(9))
         for s, loss in lines:
             assert abs(loss - expect[s]) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# fleet drill: the whole train->serve weight path under fire. A real
+# publishing trainer (subprocess, preempted mid-run) feeds a serving
+# replica pair over the negotiation control plane; the replica hot-swaps
+# generations mid-traffic, loses its peer, and every injected event must
+# be named by the postmortem from the flight dumps alone.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestDrillFleetHotSwap:
+    def test_preemption_replica_loss_swaps_and_parity(self, tmp_path):
+        """Drill (i), the fleet plane end to end: a publishing trainer
+        runs as a subprocess under an ElasticSupervisor and is SIGTERMed
+        mid-traffic (exit 45, emergency publish-commit, same-slot
+        restart — the TPU preemption shape). Two replica processes serve
+        open-loop Poisson traffic on the control plane; replica 0's
+        engine must hot-swap through >=2 published generations WHILE
+        decoding (zero drain), survive replica 1 wedging mid-stream, and
+        complete every request. Temp-0 parity: each request's tokens
+        must be bit-exact against a fresh engine running that
+        generation's recomputed weights — a swap that armed the wrong
+        bytes diverges here, not in a dashboard. Then hvd_postmortem
+        must name every injected event from the dumps: the lost replica,
+        the preemption's emergency commit, and each weight swap."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_fleet
+        import hvd_postmortem
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        traffic_started = str(tmp_path / "traffic.started")
+        wedge_now = str(tmp_path / "wedge.now")
+        done_file = str(tmp_path / "victim.done")
+
+        # pre-publish generation 1 (the trainer's exact step-0 state) so
+        # the replica can boot before the trainer exists; the trainer's
+        # publisher resumes the generation counter from the pointer
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.fleet import WeightPublisher
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.utils import checkpoint as hvd_checkpoint
+        cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                        attention_impl="full")
+        _, params0 = tr.init_params(cfg, jax.random.PRNGKey(0))
+        mgr = hvd_checkpoint.CheckpointManager(ckpt_dir, rank=0,
+                                               world_size=1,
+                                               async_save=False)
+        mgr.on_commit = WeightPublisher(ckpt_dir).publish
+        mgr.save(params0, step=0, block=True)
+        mgr.close()
+
+        trainer_env = dict(os.environ, **_ENV)
+        trainer_env["HVD_FLIGHT_DIR"] = str(tmp_path)
+
+        def fn():
+            import os
+            import time
+            import jax
+            import jax.numpy as jnp
+            from horovod_tpu.fleet import WeightSubscriber
+            from horovod_tpu.models import transformer as tr
+            from horovod_tpu.serving.engine import ServeEngine
+            from horovod_tpu.serving.queue import AdmissionQueue, Request
+            from horovod_tpu.serving.replica import ReplicaGroup
+            from horovod_tpu.utils import checkpoint as hvd_checkpoint
+            from horovod_tpu.utils import tracing as hvd_tracing
+
+            r = int(os.environ["HVD_PROCESS_ID"])
+            port = int(os.environ["DRILL_PORT"])
+            ckpt = os.environ["DRILL_CKPT"]
+            hvd_tracing.reset(enabled=True, rank=r)
+            if r == 1:
+                group = ReplicaGroup(r, 2, ("127.0.0.1", port),
+                                     key=b"k" * 32,
+                                     rank_lost_timeout_s=2.0,
+                                     start_timeout_s=120.0)
+                # healthy heartbeats until told to wedge, then silence
+                deadline = time.monotonic() + 180.0
+                while not os.path.exists(
+                        os.environ["DRILL_WEDGE_FILE"]) and \
+                        time.monotonic() < deadline:
+                    group.heartbeat()
+                    time.sleep(0.1)
+                deadline = time.monotonic() + 180.0
+                while not os.path.exists(os.environ["DRILL_DONE_FILE"]) \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                group.close(linger_s=0.0)
+                return (r, None, None, None, None, None, None)
+
+            # replica 0: warm the jit caches BEFORE joining the group
+            # (compiles inside would stall heartbeats past the window)
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params0 = tr.init_params(cfg, jax.random.PRNGKey(0))
+            warm = ServeEngine(
+                cfg, params0, num_slots=2, max_len=48, kv_block=8,
+                queue=AdmissionQueue(max_depth=8,
+                                     admission_timeout_s=1e9))
+            warm.submit(Request("warm", (3, 1, 4), max_new_tokens=4))
+            warm.run_to_completion()
+
+            # subscribe to the trainer's publications (boot generation)
+            deadline = time.monotonic() + 120.0
+            while hvd_checkpoint.latest_manifest(ckpt) is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("trainer never published")
+                time.sleep(0.05)
+            sub = WeightSubscriber(ckpt, like=params0,
+                                   poll_interval_s=0.25)
+            boot = sub.load_initial()
+            gen_step = {boot.generation: boot.step}
+
+            group = ReplicaGroup(r, 2, ("127.0.0.1", port),
+                                 key=b"k" * 32, rank_lost_timeout_s=2.0,
+                                 start_timeout_s=120.0)
+            lost_box = []
+            queue = AdmissionQueue(max_depth=64,
+                                   admission_timeout_s=1e9)
+            engine = ServeEngine(cfg, boot.params, num_slots=2,
+                                 max_len=48, kv_block=8, queue=queue,
+                                 replica=group, subscriber=sub,
+                                 on_ranks_lost=lost_box.append)
+
+            import hvd_fleet as hf
+            workload = hf.make_workload(
+                0, 12, 0.5,
+                lambda rid, prompt, n: Request(rid, prompt,
+                                               max_new_tokens=n))
+            results = []
+            i = steps = 0
+            wedged = False
+            deadline = time.monotonic() + 180.0
+            while (i < len(workload) or engine.active_count or
+                   len(engine.queue)) and time.monotonic() < deadline:
+                while i < len(workload) and workload[i][0] <= steps:
+                    engine.submit(workload[i][1])
+                    i += 1
+                results.extend(engine.step())
+                steps += 1
+                swap = engine.last_swap
+                if swap and swap["generation"] not in gen_step:
+                    gen_step[swap["generation"]] = swap["step"]
+                if results and not os.path.exists(
+                        os.environ["DRILL_START_FILE"]):
+                    with open(os.environ["DRILL_START_FILE"], "w") as f:
+                        f.write("started")  # main SIGTERMs the trainer
+                if not wedged and len(gen_step) >= 2 and \
+                        len(results) >= 3:
+                    with open(os.environ["DRILL_WEDGE_FILE"], "w") as f:
+                        f.write("wedge")  # inject the replica loss
+                    wedged = True
+                time.sleep(0.1)
+            # keep polling until >=2 swaps landed and the loss was seen
+            # (the wedge may still be pending if traffic drained fast)
+            deadline = time.monotonic() + 90.0
+            while (len(gen_step) < 3 or not wedged or not lost_box) and \
+                    time.monotonic() < deadline:
+                engine.step()
+                swap = engine.last_swap
+                if swap and swap["generation"] not in gen_step:
+                    gen_step[swap["generation"]] = swap["step"]
+                if not wedged and len(gen_step) >= 2:
+                    with open(os.environ["DRILL_WEDGE_FILE"], "w") as f:
+                        f.write("wedge")
+                    wedged = True
+                time.sleep(0.1)
+            with open(os.environ["DRILL_DONE_FILE"], "w") as f:
+                f.write("done")
+            hvd_tracing.get_tracer().dump(reason="fleet_drill")
+
+            probes = {}  # generation -> first completed request
+            prompts = {req.request_id: (req.prompt, req.max_new_tokens)
+                       for _, req in workload}
+            for res in results:
+                if res.outcome == "completed" and \
+                        res.generation not in probes:
+                    p, n = prompts[res.request_id]
+                    probes[res.generation] = (list(p), n,
+                                              list(res.tokens))
+            ttfts = sorted(res.ttft_s for res in results
+                           if res.ttft_s is not None)
+            outcomes = sorted((res.request_id, res.outcome,
+                               res.generation) for res in results)
+            return (r, sorted(gen_step.items()), lost_box,
+                    dict(sub.refusals), probes, ttfts, outcomes)
+
+        env = dict(_ENV)
+        env["HVD_FLIGHT_DIR"] = str(tmp_path)
+        env["DRILL_PORT"] = str(network.free_port())
+        env["DRILL_CKPT"] = ckpt_dir
+        env["DRILL_START_FILE"] = traffic_started
+        env["DRILL_WEDGE_FILE"] = wedge_now
+        env["DRILL_DONE_FILE"] = done_file
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root, os.path.join(repo_root, "tools")] +
+            os.environ.get("PYTHONPATH", "").split(os.pathsep))
+
+        import threading
+
+        box = []  # (supervisor, runner) once the trainer is started
+
+        def run_trainer_and_preempt():
+            # start the trainer only when traffic is flowing (a slow
+            # host's jit warmup must not let it finish unpreempted),
+            # then SIGTERM it right after its first publish
+            deadline = time.monotonic() + 150.0
+            while not os.path.exists(traffic_started) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            if not os.path.exists(traffic_started):
+                return
+            sup, runner = hvd_fleet.start_trainer(
+                str(tmp_path), ckpt_dir, steps=40, every=3,
+                sleep_s=0.3, env=trainer_env)
+            box.append((sup, runner))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                latest = hvd_checkpoint.latest_manifest(ckpt_dir)
+                if latest is not None and \
+                        int(latest[2].get("generation", 0)) >= 2:
+                    break
+                time.sleep(0.05)
+            os.kill(runner.procs[-1].pid, signal.SIGTERM)
+
+        killer = threading.Thread(target=run_trainer_and_preempt,
+                                  daemon=True)
+        killer.start()
+        try:
+            results = run(fn, num_proc=2, env=env, start_timeout_s=180.0)
+            killer.join(timeout=180.0)
+            assert box, "trainer never started: traffic never began"
+            sup, runner = box[0]
+            rc = sup.wait(poll_s=0.1)
+        finally:
+            if box:
+                box[0][0].shutdown()
+
+        # the trainer was preempted mid-run and restarted in-slot
+        assert rc == 0
+        from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+        assert sup.restarts == 1 and len(runner.procs) == 2
+        assert runner.procs[0].wait() == PREEMPTED_EXIT_CODE
+
+        by_rank = {x[0]: x for x in results}
+        _, gen_step, lost_box, refusals, probes, ttfts, outcomes = \
+            by_rank[0]
+        gen_step = dict(gen_step)
+        # >=2 mid-traffic swaps: three distinct generations served
+        assert len(gen_step) >= 3, (
+            f"expected >=2 swaps, served generations {gen_step}")
+        assert lost_box == [(1,)], lost_box
+        assert refusals == {}, refusals
+        # zero-drain SLO: every request completed, and stamped with the
+        # generation that decoded it; generous CPU-host latency bound
+        assert outcomes and all(o == "completed" for _, o, _ in outcomes)
+        assert all(g in gen_step for _, _, g in outcomes), outcomes
+        assert ttfts and ttfts[-1] < 60.0, ttfts[-5:]
+
+        # temp-0 parity: recompute each probed generation's weights from
+        # the trainer's deterministic trajectory and decode solo — a
+        # swap that armed the wrong bytes diverges token-for-token here
+        from horovod_tpu.serving.engine import ServeEngine
+        from horovod_tpu.serving.queue import AdmissionQueue, Request
+        for gen, (prompt, n_new, tokens) in sorted(probes.items())[:3]:
+            params = hvd_fleet.expected_params(
+                params0, gen_step[gen], jax.tree_util.tree_map)
+            solo = ServeEngine(
+                cfg, params, num_slots=2, max_len=48, kv_block=8,
+                queue=AdmissionQueue(max_depth=4,
+                                     admission_timeout_s=1e9))
+            solo.submit(Request("probe", tuple(prompt),
+                                max_new_tokens=n_new))
+            (ref,) = solo.run_to_completion()
+            assert list(ref.tokens) == tokens, (
+                f"generation {gen} (step {gen_step[gen]}) diverged: "
+                f"swap armed the wrong weights")
+
+        # the postmortem names every injected event from the dumps alone
+        loaded, bad = hvd_postmortem.load_dumps(
+            hvd_postmortem.find_dumps(str(tmp_path)))
+        assert not bad
+        hvd_postmortem.rebase(loaded)
+        verdict = hvd_postmortem.analyze(loaded)
+        assert verdict["divergent_rank"] == 1, verdict
+        swapped_gens = {e.get("generation")
+                        for e in verdict["weight_swaps"]}
+        assert len(swapped_gens) >= 2, verdict["weight_swaps"]
+        assert any(e.get("event") == "ckpt_emergency_exit"
+                   for e in verdict["preemptions"]), verdict
+        assert any("preempted" in r for r in verdict["reasons"]), \
+            verdict["reasons"]
+        assert any("swapped to" in r for r in verdict["reasons"]), \
+            verdict["reasons"]

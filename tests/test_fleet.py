@@ -1,0 +1,423 @@
+"""Fleet plane (horovod_tpu/fleet/): publication-pointer protocol,
+subscriber watch/arm/refuse state machine, and zero-drain hot swap in
+the serving engine — including temp-0 token-for-token parity across a
+mid-stream swap boundary (the in-flight request finishes on its
+admit-time weights unchanged; the post-swap request matches a fresh
+load of the new weights) and generation-id threading through results,
+events and request traces (docs/fleet.md)."""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.common.exceptions import (CheckpointError,
+                                           CorruptCheckpointError)
+from horovod_tpu.fleet import WeightPublisher, WeightSubscriber
+from horovod_tpu.models import transformer as tr
+from horovod_tpu.serving.queue import AdmissionQueue, Request
+from horovod_tpu.utils import checkpoint as hvd_checkpoint
+from horovod_tpu.utils import metrics as hvd_metrics
+from horovod_tpu.utils import tracing as hvd_tracing
+
+
+@pytest.fixture
+def reg():
+    r = hvd_metrics.reset(enabled=True)
+    yield r
+    hvd_metrics.reset()
+
+
+def _value(snap, name, **labels):
+    fam = snap["metrics"].get(name)
+    if fam is None:
+        return None
+    for v in fam["values"]:
+        if all(v["labels"].get(k) == lv for k, lv in labels.items()):
+            return v.get("value", v.get("count"))
+    return None
+
+
+def _events(snap, kind):
+    return [e for e in snap["events"] if e["event"] == kind]
+
+
+def _publishing_manager(directory):
+    """A synchronous CheckpointManager with a WeightPublisher attached —
+    the trainer-side wiring, minus the trainer."""
+    mgr = hvd_checkpoint.CheckpointManager(str(directory), rank=0,
+                                           world_size=1, async_save=False)
+    pub = WeightPublisher(str(directory))
+    mgr.on_commit = pub.publish
+    return mgr, pub
+
+
+# ---------------------------------------------------------------------------
+# checkpoint plane: the publication pointer
+# ---------------------------------------------------------------------------
+
+class TestLatestManifest:
+    def test_empty_directory_is_none(self, tmp_path):
+        assert hvd_checkpoint.latest_manifest(str(tmp_path)) is None
+        assert hvd_checkpoint.manifest_signature(str(tmp_path)) is None
+
+    def test_pointer_names_newest_commit(self, reg, tmp_path):
+        mgr, _pub = _publishing_manager(tmp_path)
+        tree = {"w": np.arange(6, dtype=np.float32)}
+        mgr.save(tree, 3, block=True)
+        mgr.save(tree, 7, block=True)
+        mgr.close()
+        step, d, manifest = hvd_checkpoint.latest_manifest(str(tmp_path))
+        assert step == 7
+        assert d.endswith("step-0000000007")
+        assert manifest["generation"] == 2
+        assert manifest["dir"] == "step-0000000007"
+        # the pointer carries the full checksum set of the commit
+        assert manifest["files"]
+
+    def test_signature_changes_on_republish(self, reg, tmp_path):
+        mgr, _pub = _publishing_manager(tmp_path)
+        tree = {"w": np.arange(6, dtype=np.float32)}
+        mgr.save(tree, 1, block=True)
+        sig1 = hvd_checkpoint.manifest_signature(str(tmp_path))
+        assert sig1 is not None
+        mgr.save(tree, 2, block=True)
+        mgr.close()
+        assert hvd_checkpoint.manifest_signature(str(tmp_path)) != sig1
+
+    def test_scan_fallback_without_pointer(self, reg, tmp_path):
+        # a pre-fleet checkpoint directory: no publisher ever ran
+        mgr = hvd_checkpoint.CheckpointManager(str(tmp_path), rank=0,
+                                               world_size=1,
+                                               async_save=False)
+        mgr.save({"w": np.ones(3, np.float32)}, 5, block=True)
+        mgr.close()
+        step, _d, manifest = hvd_checkpoint.latest_manifest(str(tmp_path))
+        assert step == 5
+        assert "generation" not in manifest
+
+    def test_scan_retries_gc_unlink_race(self, reg, tmp_path,
+                                         monkeypatch):
+        # GC unlinking a manifest between the listdir and the read is
+        # the TOCTOU window latest_manifest must survive
+        mgr = hvd_checkpoint.CheckpointManager(str(tmp_path), rank=0,
+                                               world_size=1,
+                                               async_save=False)
+        mgr.save({"w": np.ones(3, np.float32)}, 5, block=True)
+        mgr.close()
+        real = hvd_checkpoint._read_global_manifest
+        calls = []
+
+        def flaky(d):
+            if not calls:
+                calls.append(1)
+                err = CorruptCheckpointError("vanished under the reader")
+                err.__cause__ = FileNotFoundError(d)
+                raise err
+            return real(d)
+
+        monkeypatch.setattr(hvd_checkpoint, "_read_global_manifest",
+                            flaky)
+        step, _d, _m = hvd_checkpoint.latest_manifest(str(tmp_path))
+        assert step == 5 and calls  # retried past the vanished read
+
+    def test_pointer_is_not_a_legacy_checkpoint(self, reg, tmp_path):
+        # the top-level manifest.json must never be misread as a
+        # format-1 checkpoint by the legacy path
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save({"w": np.ones(3, np.float32)}, 1, block=True)
+        mgr.close()
+        assert hvd_checkpoint._legacy_dir(str(tmp_path)) is None
+        tree, step = hvd_checkpoint.restore(str(tmp_path))
+        assert step == 1 and len(tree) == 1
+
+
+class TestWeightPublisher:
+    def test_generations_are_monotonic_across_restart(self, reg,
+                                                      tmp_path):
+        mgr, pub = _publishing_manager(tmp_path)
+        tree = {"w": np.arange(4, dtype=np.float32)}
+        mgr.save(tree, 1, block=True)
+        mgr.save(tree, 2, block=True)
+        mgr.close()
+        assert pub.next_generation == 3
+        # a preempted-and-restarted trainer builds a fresh publisher: it
+        # must continue the sequence, not restart it
+        pub2 = WeightPublisher(str(tmp_path))
+        assert pub2.next_generation == 3
+        mgr2 = hvd_checkpoint.CheckpointManager(str(tmp_path), rank=0,
+                                                world_size=1,
+                                                async_save=False,
+                                                on_commit=pub2.publish)
+        mgr2.save(tree, 3, block=True)
+        mgr2.close()
+        _s, _d, manifest = hvd_checkpoint.latest_manifest(str(tmp_path))
+        assert manifest["generation"] == 3
+
+    def test_publish_event_and_metrics(self, reg, tmp_path):
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save({"w": np.ones(2, np.float32)}, 1, block=True)
+        mgr.close()
+        snap = reg.snapshot()
+        (ev,) = _events(snap, "fleet_publish")
+        assert ev["generation"] == 1 and ev["step"] == 1
+        assert _value(snap, "hvd_fleet_publishes_total") == 1
+        assert _value(snap, "hvd_fleet_published_generation") == 1
+
+
+# ---------------------------------------------------------------------------
+# subscriber state machine (no engine: plain numpy trees)
+# ---------------------------------------------------------------------------
+
+class TestWeightSubscriber:
+    def test_load_initial_then_poll_arms_new_generation(self, reg,
+                                                        tmp_path):
+        like = {"w": np.zeros(4, np.float32)}
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save({"w": np.full(4, 1.0, np.float32)}, 1, block=True)
+        sub = WeightSubscriber(str(tmp_path), like=like,
+                               poll_interval_s=0.0, device_put=False)
+        init = sub.load_initial()
+        assert init.generation == 1
+        assert sub.current_generation == 1
+        assert np.all(np.asarray(init.params["w"]) == 1.0)
+        assert sub.poll() is False  # nothing new published
+        mgr.save({"w": np.full(4, 2.0, np.float32)}, 2, block=True)
+        mgr.close()
+        assert sub.poll() is True
+        assert sub.wait(30)
+        rec = sub.take_armed()
+        assert rec.generation == 2
+        assert np.all(np.asarray(rec.params["w"]) == 2.0)
+        assert sub.current_generation == 2
+        assert rec.loaded_ts >= rec.detect_ts
+        assert rec.armed_ts >= rec.loaded_ts
+
+    def test_corrupt_generation_refused(self, reg, tmp_path):
+        like = {"w": np.zeros(4, np.float32)}
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save({"w": np.ones(4, np.float32)}, 1, block=True)
+        sub = WeightSubscriber(str(tmp_path), like=like,
+                               poll_interval_s=0.0, device_put=False)
+        sub.load_initial()
+        mgr.save({"w": np.full(4, 2.0, np.float32)}, 2, block=True)
+        shard = os.path.join(str(tmp_path), "step-0000000002",
+                             "rank00000.npz")
+        with open(shard, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xff\xff\xff")
+        assert sub.poll() is True
+        assert sub.wait(30)
+        assert sub.take_armed() is None  # the swap was refused
+        assert sub.current_generation == 1
+        assert sub.refusals == {2: "corrupt"}
+        snap = reg.snapshot()
+        (ev,) = _events(snap, "fleet_refuse")
+        assert ev["generation"] == 2 and ev["reason"] == "corrupt"
+        assert _value(snap, "hvd_fleet_refusals_total",
+                      reason="corrupt") == 1
+        # a refused generation is remembered: no poll livelock
+        assert sub.poll(force=True) is False
+        # ...and the next GOOD publish arms normally
+        mgr.save({"w": np.full(4, 3.0, np.float32)}, 3, block=True)
+        mgr.close()
+        assert sub.poll(force=True) is True
+        assert sub.wait(30)
+        assert sub.take_armed().generation == 3
+
+    def test_mismatched_tree_refused(self, reg, tmp_path):
+        like = {"w": np.zeros(4, np.float32)}
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save({"w": np.ones(4, np.float32)}, 1, block=True)
+        sub = WeightSubscriber(str(tmp_path), like=like,
+                               poll_interval_s=0.0, device_put=False)
+        sub.load_initial()
+        # the trainer "changed model shape": different leaf names
+        mgr.save({"w": np.ones(4, np.float32),
+                  "extra_head": np.ones(2, np.float32)}, 2, block=True)
+        mgr.close()
+        assert sub.poll() is True
+        assert sub.wait(30)
+        assert sub.take_armed() is None
+        assert sub.refusals[2] == "mismatch"
+
+    def test_latest_wins_double_buffer(self, reg, tmp_path):
+        like = {"w": np.zeros(2, np.float32)}
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save({"w": np.full(2, 1.0, np.float32)}, 1, block=True)
+        sub = WeightSubscriber(str(tmp_path), like=like,
+                               poll_interval_s=0.0, device_put=False)
+        sub.load_initial()
+        mgr.save({"w": np.full(2, 2.0, np.float32)}, 2, block=True)
+        assert sub.poll() and sub.wait(30)
+        # gen 2 is armed but untaken when gen 3 publishes: the standby
+        # buffer is replaced, never stacked
+        mgr.save({"w": np.full(2, 3.0, np.float32)}, 3, block=True)
+        mgr.close()
+        assert sub.poll(force=True) and sub.wait(30)
+        rec = sub.take_armed()
+        assert rec.generation == 3
+        assert sub.take_armed() is None
+
+
+# ---------------------------------------------------------------------------
+# ServeEngine hot swap (CPU, tiny fp32 config)
+# ---------------------------------------------------------------------------
+
+def _tiny():
+    cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                    attention_impl="full")
+    _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    from horovod_tpu.serving.engine import ServeEngine
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("kv_block", 8)
+    kw.setdefault("queue", AdmissionQueue(max_depth=64,
+                                          admission_timeout_s=1e9))
+    return ServeEngine(cfg, params, **kw)
+
+
+def _solo_tokens(cfg, params, prompt, n_new):
+    """Fresh-engine greedy output for one request — the parity oracle
+    for a given weight tree."""
+    eng = _engine(cfg, params)
+    eng.submit(Request("ref", prompt, max_new_tokens=n_new,
+                       temperature=0.0))
+    (res,) = eng.run_to_completion()
+    assert res.outcome == "completed"
+    return res.tokens
+
+
+class TestEngineHotSwap:
+    def test_temp0_parity_across_mid_stream_swap(self, reg, tmp_path):
+        """The tentpole invariant: an in-flight request crosses the
+        swap boundary token-for-token unchanged (it finishes on its
+        admit-time weights), while a post-swap request matches a fresh
+        load of the new weights — zero drain, no blended decode."""
+        hvd_tracing.reset(enabled=True, rank=0)
+        try:
+            cfg, params0 = _tiny()
+            params1 = jax.tree_util.tree_map(lambda a: a * 1.5, params0)
+            mgr, _pub = _publishing_manager(tmp_path)
+            mgr.save(params0, 1, block=True)
+            sub = WeightSubscriber(str(tmp_path), like=params0,
+                                   poll_interval_s=0.0)
+            init = sub.load_initial()
+            eng = _engine(cfg, init.params, subscriber=sub,
+                          generation=init.generation)
+            assert eng.generation == 1
+            prompt = tuple(int(t) for t in
+                           np.arange(1, 7) % cfg.vocab_size)
+            eng.submit(Request("old-gen", prompt, max_new_tokens=20,
+                               temperature=0.0))
+            results = {}
+            for _ in range(6):  # prefill + a few decode steps
+                for r in eng.step():
+                    results[r.request_id] = r
+            assert eng.active_count == 1  # old-gen still mid-stream
+            mgr.save(params1, 2, block=True)
+            mgr.close()
+            assert sub.poll(force=True) and sub.wait(30)
+            eng.submit(Request("new-gen", prompt, max_new_tokens=8,
+                               temperature=0.0))
+            for _ in range(300):
+                for r in eng.step():
+                    results[r.request_id] = r
+                if len(results) == 2:
+                    break
+            assert eng.generation == 2
+            old, new = results["old-gen"], results["new-gen"]
+            assert old.generation == 1 and new.generation == 2
+            # token-for-token parity on both sides of the boundary
+            assert old.tokens == _solo_tokens(cfg, params0, prompt, 20)
+            assert new.tokens == _solo_tokens(cfg, params1, prompt, 8)
+            # the swap is observable: event, metrics, engine record
+            snap = reg.snapshot()
+            (swap,) = _events(snap, "fleet_swap")
+            assert swap["generation"] == 2
+            assert swap["from_generation"] == 1
+            assert swap["inflight"] >= 1
+            for phase in ("detect_to_loaded_ms", "loaded_to_armed_ms",
+                          "armed_to_swapped_ms", "total_ms"):
+                assert swap[phase] >= 0.0
+            assert _value(snap, "hvd_fleet_swaps_total") == 1
+            assert _value(snap, "hvd_fleet_generation", replica="0") == 2
+            admits = {e["request_id"]: e for e in
+                      _events(snap, "serve_admit")}
+            assert admits["old-gen"]["generation"] == 1
+            assert admits["new-gen"]["generation"] == 2
+            retires = {e["request_id"]: e for e in
+                       _events(snap, "serve_retire")}
+            assert retires["old-gen"]["generation"] == 1
+            assert retires["new-gen"]["generation"] == 2
+            # old params were dropped once their last request retired
+            assert set(eng._params_by_gen) == {2}
+        finally:
+            hvd_tracing.reset()
+
+    def test_generation_annotated_on_request_trace(self, reg, tmp_path):
+        hvd_tracing.reset(enabled=True, rank=0)
+        try:
+            cfg, params = _tiny()
+            eng = _engine(cfg, params, generation=7)
+            req = Request("traced", (1, 2, 3), max_new_tokens=3,
+                          temperature=0.0)
+            eng.submit(req)
+            (res,) = eng.run_to_completion()
+            assert res.generation == 7
+            assert req.trace.root.attrs["generation"] == 7
+        finally:
+            hvd_tracing.reset()
+
+    def test_engine_without_subscriber_defaults_generation_zero(
+            self, reg):
+        cfg, params = _tiny()
+        eng = _engine(cfg, params)
+        eng.submit(Request("plain", (1, 2, 3), max_new_tokens=2,
+                           temperature=0.0))
+        (res,) = eng.run_to_completion()
+        assert res.generation == 0
+
+    def test_corrupt_publish_keeps_serving_old_generation(self, reg,
+                                                          tmp_path):
+        cfg, params0 = _tiny()
+        mgr, _pub = _publishing_manager(tmp_path)
+        mgr.save(params0, 1, block=True)
+        sub = WeightSubscriber(str(tmp_path), like=params0,
+                               poll_interval_s=0.0)
+        init = sub.load_initial()
+        eng = _engine(cfg, init.params, subscriber=sub,
+                      generation=init.generation)
+        params1 = jax.tree_util.tree_map(lambda a: a * 2.0, params0)
+        mgr.save(params1, 2, block=True)
+        mgr.close()
+        shard = os.path.join(str(tmp_path), "step-0000000002",
+                             "rank00000.npz")
+        with open(shard, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xff\xff\xff")
+        prompt = (1, 2, 3, 4)
+        eng.submit(Request("survivor", prompt, max_new_tokens=6,
+                           temperature=0.0))
+        assert sub.poll(force=True) and sub.wait(30)
+        (res,) = eng.run_to_completion()
+        # the engine never swapped: still generation 1, still serving,
+        # and its output matches the old weights exactly
+        assert eng.generation == 1
+        assert res.generation == 1
+        assert res.outcome == "completed"
+        assert res.tokens == _solo_tokens(cfg, params0, prompt, 6)
+        snap = reg.snapshot()
+        assert _events(snap, "fleet_refuse")
+        assert not _events(snap, "fleet_swap")
+        assert _value(snap, "hvd_fleet_refusals_total",
+                      reason="corrupt") == 1

@@ -970,6 +970,90 @@ def test_hvd014_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD015 — ad-hoc weight load in the serving plane
+# ---------------------------------------------------------------------------
+
+def test_hvd015_triggers_on_manager_restore_in_serve_path(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_path
+
+        def refresh(self, step):
+            params = self.manager.restore(step)
+            extra = self.checkpoint.restore_with_extra(like=params)
+            return params, extra
+        """)
+    assert [f.rule for f in live(found)] == ["HVD015"] * 2
+
+
+def test_hvd015_triggers_in_real_serving_module(tmp_path):
+    mod = tmp_path / "horovod_tpu" / "serving"
+    mod.mkdir(parents=True)
+    f = mod / "engine.py"
+    f.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def reload_weights(path):
+            return np.load(path)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD015"]
+
+
+def test_hvd015_triggers_on_bare_import_alias(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_path
+        from horovod_tpu.utils.checkpoint import restore
+
+        def refresh(path, like):
+            return restore(path, like=like)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD015"]
+
+
+def test_hvd015_subscriber_layer_is_sanctioned(tmp_path):
+    # fleet/subscriber.py IS the weight-load path: restore there is the
+    # mechanism, not a rival
+    mod = tmp_path / "horovod_tpu" / "fleet"
+    mod.mkdir(parents=True)
+    f = mod / "subscriber.py"
+    f.write_text(textwrap.dedent("""\
+        from horovod_tpu.utils import checkpoint
+
+        def _restore(d, like):
+            return checkpoint.restore_with_extra(d, like=like)
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd015_outside_serving_plane_is_clean(tmp_path):
+    # the trainer restoring its own checkpoint is the normal resume
+    # path, not an ad-hoc serving-side load
+    found = lint_source(tmp_path, """\
+        def resume(self):
+            return self.manager.restore(like=self.params)
+        """)
+    assert live(found) == []
+
+
+def test_hvd015_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=serve_path
+
+        def warm_start(self):
+            # hvdlint: disable=HVD015(one-time boot load before the subscriber exists)
+            return self.manager.restore(like=self.params)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD015"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -1029,7 +1113,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 15)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 16)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

@@ -1,0 +1,352 @@
+"""hvd_fleet: the fleet drill — a publishing trainer feeding hot-swapping
+serving replicas on one host.
+
+The fleet plane (docs/fleet.md) is the train→serve weight path: every
+checkpoint commit the trainer's rank 0 makes becomes a published weight
+generation (``WeightPublisher`` writes the publication pointer inside
+the commit hook), and each serving replica's ``WeightSubscriber``
+background-loads it, checksum-verifies, and arms it for the engine to
+swap at a step boundary — in-flight requests finish on the old weights,
+new admissions decode on the new ones, nothing drains.
+
+This tool drives that loop end to end on localhost:
+
+- ``--drill`` runs a real publishing trainer as a subprocess under an
+  ElasticSupervisor (SIGTERM mid-run exits 45 and restarts in the same
+  slot, exactly like a TPU preemption) while an in-process ServeEngine
+  with a WeightSubscriber serves open-loop Poisson traffic across the
+  generations the trainer publishes. Prints ONE JSON line: swaps
+  observed, per-generation request counts, publication/refusal totals,
+  and the last swap's phase latency decomposition.
+- ``--selftest`` runs the single-process publish→subscribe→arm→take
+  round-trip on a tiny numpy tree (no jax, no engine) and prints OK —
+  the CI smoke for the fleet wiring.
+
+The chaos drill in tests/test_chaos_plane.py reuses the trainer
+template and helpers here and adds the assertions (SLO bounds, temp-0
+parity across swaps, postmortem naming every injected event).
+
+Usage:
+    python tools/hvd_fleet.py --selftest
+    python tools/hvd_fleet.py --drill [--steps N] [--requests N]
+        [--preempt] [--dir DIR]
+
+Runbook: docs/fleet.md ("The fleet drill").
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The drill trainer: deterministic per-step weight evolution (the factor
+# depends only on the step index, so a preempted-and-restarted run
+# continues the SAME trajectory from the restored tree) with every
+# commit published as a weight generation. Serving-side temp-0 parity
+# checks recompute any generation's params as params0 * prod(factors),
+# so a swap that armed the wrong bytes shows up as diverged tokens, not
+# a vibe. Exits PREEMPTED_EXIT_CODE on SIGTERM after an emergency
+# publish-commit, like a real preemption.
+TRAINER_TEMPLATE = """\
+import os, sys, time
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import trainer
+from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+from horovod_tpu.models import transformer as tr
+from horovod_tpu.utils import tracing as hvd_tracing
+
+rank = 2 + int(os.environ.get("DRILL_RUN", "0"))  # run 1 dumps as rank 3
+hvd_tracing.reset(enabled=True, rank=rank)
+ck = trainer.Checkpointer(os.environ["DRILL_CKPT"],
+                          every=int(os.environ["DRILL_EVERY"]),
+                          async_save=False, publish=True)
+cfg = tr.TransformerConfig.tiny(dtype=jnp.float32, attention_impl="full")
+_, params0 = tr.init_params(cfg, jax.random.PRNGKey(0))
+state, start, extra = ck.resume(like=params0)
+params = params0 if start == 0 else state
+steps = int(os.environ["DRILL_STEPS"])
+for i in range(start, steps):
+    factor = 1.0 + 0.01 * ((i % 7) + 1)  # step-determined: resumable
+    params = jax.tree_util.tree_map(lambda x: x * factor, params)
+    time.sleep(float(os.environ["DRILL_SLEEP"]))
+    if ck.step_end(i + 1, params, extra={"data_pos": i + 1}):
+        hvd_tracing.get_tracer().dump(reason="preempted")
+        sys.exit(PREEMPTED_EXIT_CODE)
+ck.close()
+hvd_tracing.get_tracer().dump(reason="drill_done")
+"""
+
+
+def step_factor(i):
+    """The trainer template's weight factor for step index ``i`` — the
+    parity oracle recomputes published generations with this."""
+    return 1.0 + 0.01 * ((i % 7) + 1)
+
+
+def expected_params(params0, step, tree_map):
+    """params after ``step`` trainer steps — the SAME iterative fp32
+    multiplies the drill trainer executes (a one-shot product of the
+    factors rounds differently), so temp-0 parity against a published
+    generation is bit-exact, not approximate."""
+    def seq(x):
+        for i in range(step):
+            x = x * step_factor(i)
+        return x
+    return tree_map(seq, params0)
+
+
+class CapturingRunner:
+    """ElasticSupervisor runner that launches the real subprocess and
+    remembers it so the drill can deliver signals to the CURRENT job,
+    bumping DRILL_RUN so each incarnation traces under its own rank."""
+
+    def __init__(self, env):
+        self.env = env
+        self.procs = []
+
+    def __call__(self, argv):
+        env = dict(self.env, DRILL_RUN=str(len(self.procs)))
+        p = subprocess.Popen(argv, env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        self.procs.append(p)
+        return p
+
+
+def start_trainer(workdir, ckpt_dir, steps, every, sleep_s, env=None):
+    """Write the trainer template into ``workdir`` and start it under an
+    ElasticSupervisor that treats exit 45 as a same-slot restart.
+    Returns (supervisor, runner)."""
+    from horovod_tpu.common.exceptions import PREEMPTED_EXIT_CODE
+    from horovod_tpu.run.elastic import ElasticSupervisor
+
+    script = os.path.join(workdir, "fleet_trainer.py")
+    with open(script, "w") as f:
+        f.write(TRAINER_TEMPLATE)
+    penv = dict(os.environ if env is None else env)
+    penv.setdefault("JAX_PLATFORMS", "cpu")
+    penv["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))] +
+        penv.get("PYTHONPATH", "").split(os.pathsep))
+    penv.update(DRILL_CKPT=ckpt_dir, DRILL_STEPS=str(steps),
+                DRILL_EVERY=str(every), DRILL_SLEEP=str(sleep_s))
+    runner = CapturingRunner(penv)
+    sup = ElasticSupervisor("localhost:1",
+                            [sys.executable, script],
+                            ports=(0,), verbose=0, runner=runner,
+                            graceful_restart_rc=PREEMPTED_EXIT_CODE)
+    sup.start()
+    return sup, runner
+
+
+def make_workload(seed, n_requests, rate, make_request, short_tokens=6,
+                  long_tokens=24, long_frac=0.25, prompt_lens=(3, 6)):
+    """Open-loop Poisson arrival schedule [(arrival_step, request)] —
+    the same honest open-loop shape the serving bench uses, generated
+    locally so the drill has no example-script dependency."""
+    r = np.random.RandomState(seed)
+    t = 0.0
+    out = []
+    for i in range(n_requests):
+        t += r.exponential(1.0 / rate)
+        n_new = long_tokens if r.rand() < long_frac else short_tokens
+        plen = int(r.randint(prompt_lens[0], prompt_lens[1] + 1))
+        prompt = tuple(int(x) for x in r.randint(1, 250, plen))
+        out.append((t, make_request(f"req-{i}", prompt, n_new)))
+    return out
+
+
+def drive(engine, workload, pace_s=0.0, on_step=None, deadline_s=300.0):
+    """Open-loop drive: submit every request whose arrival step has
+    passed, step the engine, collect results. ``on_step(steps, results)``
+    lets the drill inject faults mid-traffic."""
+    i = steps = 0
+    results = []
+    deadline = time.monotonic() + deadline_s
+    while i < len(workload) or engine.active_count or len(engine.queue):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"drill traffic never drained ({len(results)} done, "
+                f"{engine.active_count} active)")
+        while i < len(workload) and workload[i][0] <= steps:
+            engine.submit(workload[i][1])
+            i += 1
+        results.extend(engine.step())
+        steps += 1
+        if on_step is not None:
+            on_step(steps, results)
+        if pace_s:
+            time.sleep(pace_s)
+    return results, steps
+
+
+def run_drill(workdir, steps=18, every=3, sleep_s=0.25, n_requests=24,
+              rate=0.5, preempt=True):
+    """The localhost fleet drill: publishing trainer subprocess (with an
+    optional SIGTERM preemption mid-run) + one in-process replica under
+    Poisson traffic. Returns the summary dict."""
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.fleet import WeightSubscriber
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.serving.engine import ServeEngine
+    from horovod_tpu.serving.queue import AdmissionQueue, Request
+    from horovod_tpu.utils import checkpoint as hvd_checkpoint
+    from horovod_tpu.utils import metrics as hvd_metrics
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                    attention_impl="full")
+    _, params0 = tr.init_params(cfg, jax.random.PRNGKey(0))
+
+    sup, runner = start_trainer(workdir, ckpt_dir, steps, every, sleep_s)
+    try:
+        # wait for the first published generation, then subscribe
+        deadline = time.monotonic() + 120.0
+        while hvd_checkpoint.latest_manifest(ckpt_dir) is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("trainer never published a generation")
+            time.sleep(0.05)
+        sub = WeightSubscriber(ckpt_dir, like=params0, poll_interval_s=0.1)
+        boot = sub.load_initial()
+        queue = AdmissionQueue(max_depth=n_requests + 1,
+                               admission_timeout_s=1e9)
+        engine = ServeEngine(cfg, boot.params, num_slots=2, max_len=48,
+                             kv_block=8, queue=queue, subscriber=sub)
+
+        workload = make_workload(
+            0, n_requests, rate,
+            lambda rid, prompt, n: Request(rid, prompt, max_new_tokens=n))
+        preempted = []
+
+        def on_step(nsteps, results):
+            if preempt and not preempted and len(results) >= 4:
+                os.kill(runner.procs[-1].pid, signal.SIGTERM)
+                preempted.append(nsteps)
+
+        results, nsteps = drive(engine, workload, pace_s=sleep_s / 4,
+                                on_step=on_step)
+        rc = sup.wait(poll_s=0.1)
+    finally:
+        sup.shutdown()
+
+    by_gen = {}
+    for r in results:
+        by_gen[r.generation] = by_gen.get(r.generation, 0) + 1
+    snap = hvd_metrics.get_registry().snapshot()
+    return {
+        "trainer_rc": rc,
+        "trainer_incarnations": len(runner.procs),
+        "preempted_at_step": preempted[0] if preempted else None,
+        "requests": len(results),
+        "completed": sum(1 for r in results if r.outcome == "completed"),
+        "decode_steps": nsteps,
+        "generations_served": sorted(k for k in by_gen if k is not None),
+        "requests_by_generation": {str(k): v for k, v in
+                                   sorted(by_gen.items())},
+        "swaps": len([k for k in by_gen if k is not None]) - 1,
+        "refusals": dict(sub.refusals),
+        "last_swap": engine.last_swap,
+    }
+
+
+def selftest():
+    """publish→subscribe→arm→take on a numpy tree, plus a corrupt-shard
+    refusal — single process, no jax, no engine."""
+    from horovod_tpu.fleet import WeightPublisher, WeightSubscriber
+    from horovod_tpu.utils import checkpoint as hvd_checkpoint
+
+    tmp = tempfile.mkdtemp(prefix="hvd-fleet-selftest-")
+    try:
+        mgr = hvd_checkpoint.CheckpointManager(tmp, rank=0, world_size=1,
+                                               async_save=False)
+        pub = WeightPublisher(tmp)
+        mgr.on_commit = pub.publish
+        tree = {"w": np.zeros(4, np.float32), "b": np.ones(2, np.float32)}
+        mgr.save(tree, step=1, block=True)
+
+        sub = WeightSubscriber(tmp, like=tree, poll_interval_s=0.0,
+                               device_put=False)
+        sub.load_initial()
+        assert sub.current_generation == 1, sub.current_generation
+
+        tree2 = {"w": np.full(4, 2.0, np.float32),
+                 "b": np.full(2, 3.0, np.float32)}
+        mgr.save(tree2, step=2, block=True)
+        assert sub.poll(force=True), "new generation not detected"
+        sub.wait(timeout=30.0)
+        rec = sub.take_armed()
+        assert rec is not None and rec.generation == 2, rec
+        assert float(np.asarray(rec.params["w"])[0]) == 2.0
+        assert sub.current_generation == 2
+
+        # a torn shard must refuse loudly and keep the old generation
+        mgr.save(tree, step=3, block=True)
+        step_dir = hvd_checkpoint.latest_manifest(tmp)[1]
+        shard = os.path.join(step_dir, "rank00000.npz")
+        with open(shard, "r+b") as f:
+            f.write(b"\xff\xff\xff\xff")
+        assert sub.poll(force=True), "corrupt generation not detected"
+        sub.wait(timeout=30.0)
+        assert sub.take_armed() is None, "corrupt generation was armed"
+        assert 3 in sub.refusals and sub.refusals[3] == "corrupt", \
+            sub.refusals
+        assert sub.current_generation == 2
+        mgr.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("hvd_fleet selftest: OK")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--selftest", action="store_true",
+                    help="single-process fleet wiring round-trip")
+    ap.add_argument("--drill", action="store_true",
+                    help="trainer subprocess + replica under traffic")
+    ap.add_argument("--steps", type=int, default=18)
+    ap.add_argument("--every", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--rate", type=float, default=0.5)
+    ap.add_argument("--no-preempt", action="store_true",
+                    help="skip the mid-traffic SIGTERM preemption")
+    ap.add_argument("--dir", default=None,
+                    help="working directory (default: a temp dir)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.drill:
+        print(__doc__.splitlines()[0])
+        print("nothing to do: pass --selftest or --drill")
+        return 2
+
+    workdir = args.dir or tempfile.mkdtemp(prefix="hvd-fleet-drill-")
+    os.makedirs(workdir, exist_ok=True)
+    try:
+        out = run_drill(workdir, steps=args.steps, every=args.every,
+                        n_requests=args.requests, rate=args.rate,
+                        preempt=not args.no_preempt)
+        print(json.dumps(out, default=str))
+        return 0 if out["trainer_rc"] == 0 and out["swaps"] >= 1 else 1
+    finally:
+        if args.dir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,8 @@ LEGS = (
         higher_better=False, pm_path=("transformer_lm", "ms_per_step_pm"),
         context_paths=_LM_CTX),
     Leg("serve_speedup", ("serve", "speedup_tokens_per_step")),
+    Leg("serve_swap_dip_pct", ("swap", "dip_pct"),
+        higher_better=False),
     Leg("ckpt_overhead_pct", ("ckpt", "overhead_pct"),
         higher_better=False),
 )

@@ -263,6 +263,37 @@ def analyze(dumps):
             "failure time (run tools/hvd_slo.py for the tail "
             "attribution)")
 
+    # 6. fleet plane: the train->serve weight timeline. Swaps and
+    # refusals answer "which weights decoded this" (a quality regression
+    # after a push starts here); preemption events tie a trainer's exit
+    # 45 to the emergency commit the restart resumed from.
+    swaps, refusals, preemptions = [], [], []
+    for d in dumps:
+        for e in d.get("events", []):
+            kind = e.get("event")
+            if kind == "fleet_swap":
+                swaps.append({"dump_rank": _rank_of(d), **e})
+                reasons.append(
+                    f"fleet: replica {e.get('replica')} swapped to "
+                    f"weight generation {e.get('generation')} (from "
+                    f"{e.get('from_generation')}, step {e.get('step')}) "
+                    f"with {e.get('inflight')} request(s) in flight")
+            elif kind == "fleet_refuse":
+                refusals.append({"dump_rank": _rank_of(d), **e})
+                reasons.append(
+                    f"fleet: replica {e.get('replica')} REFUSED "
+                    f"generation {e.get('generation')} "
+                    f"({e.get('reason')}) and kept serving its current "
+                    f"weights")
+            elif kind in ("ckpt_preempt", "ckpt_emergency_exit"):
+                preemptions.append({"dump_rank": _rank_of(d), **e})
+                if kind == "ckpt_emergency_exit":
+                    reasons.append(
+                        f"trainer (dump rank {_rank_of(d)}) was "
+                        f"preempted and committed an emergency "
+                        f"checkpoint at step {e.get('step')} before "
+                        f"exiting 45")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -309,6 +340,9 @@ def analyze(dumps):
         "numerics_anomalies": numerics,
         "first_bad_cycle": first_bad,
         "inflight_requests": sorted(inflight),
+        "weight_swaps": swaps,
+        "fleet_refusals": refusals,
+        "preemptions": preemptions,
     }
 
 
@@ -360,6 +394,19 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
     if verdict.get("inflight_requests"):
         lines.append(f"  in-flight serve requests: "
                      f"{verdict['inflight_requests']}")
+    if verdict.get("weight_swaps"):
+        gens = [e.get("generation") for e in verdict["weight_swaps"]]
+        lines.append(f"  weight swaps   : {len(gens)} "
+                     f"(generations {gens})")
+    if verdict.get("fleet_refusals"):
+        lines.append(f"  fleet refusals : "
+                     f"{[(e.get('generation'), e.get('reason')) for e in verdict['fleet_refusals']]}")
+    if verdict.get("preemptions"):
+        steps = sorted({e.get("step") for e in verdict["preemptions"]
+                        if e.get("step") is not None})
+        lines.append(f"  preemptions    : "
+                     f"{len([e for e in verdict['preemptions'] if e.get('event') == 'ckpt_preempt'])} "
+                     f"(emergency commit at steps {steps})")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -408,7 +455,9 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
             if e.get("event") in ("stall", "stall_kill", "ranks_lost",
                                   "chaos_injection", "slow_span",
                                   "numerics_anomaly", "serve_failover",
-                                  "slow_decode_tick"):
+                                  "slow_decode_tick", "fleet_publish",
+                                  "fleet_swap", "fleet_refuse",
+                                  "ckpt_preempt", "ckpt_emergency_exit"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
@@ -461,7 +510,9 @@ def chrome_trace(dumps, stitched):
             kind = e.get("event")
             if kind in ("stall", "stall_kill", "ranks_lost",
                         "chaos_injection", "numerics_anomaly",
-                        "serve_failover"):
+                        "serve_failover", "fleet_publish", "fleet_swap",
+                        "fleet_refuse", "ckpt_preempt",
+                        "ckpt_emergency_exit"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

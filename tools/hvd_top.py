@@ -470,6 +470,50 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
                              f"p50 {_fmt_s(pp50):>8}   "
                              f"p99 {_fmt_s(pp99):>8}")
 
+    # fleet plane: published weight generations and per-replica hot-swap
+    # state (horovod_tpu/fleet/; docs/fleet.md)
+    pub_gen = _total(snap, "hvd_fleet_published_generation")
+    by_replica = _by_label(snap, "hvd_fleet_generation", "replica")
+    refuse = _by_label(snap, "hvd_fleet_refusals_total", "reason")
+    if pub_gen or by_replica or refuse:
+        lines.append(c(BOLD, "  fleet"))
+        lines.append(
+            f"    published     generation {int(pub_gen):>6,}   "
+            f"publishes {int(_total(snap, 'hvd_fleet_publishes_total')):,}"
+            f"   swaps {int(_total(snap, 'hvd_fleet_swaps_total')):,}")
+        inprog = _by_label(snap, "hvd_fleet_swap_in_progress", "replica")
+        last = _by_label(snap, "hvd_fleet_last_swap_seconds", "replica")
+        for rep in sorted(by_replica, key=str):
+            gen = by_replica[rep]
+            stale = pub_gen and gen < pub_gen and \
+                not inprog.get(rep, 0)
+            rep_line = (f"    replica {rep:<5} generation {int(gen):>6,}"
+                        f"   swapping {'yes' if inprog.get(rep) else ' no'}"
+                        f"   last swap {_fmt_s(last.get(rep)):>8}")
+            lines.append(c(YELLOW, rep_line) if stale else rep_line)
+        if refuse:
+            ref_s = "  ".join(f"{k}={int(v):,}"
+                              for k, v in sorted(refuse.items()))
+            lines.append(c(RED, f"    REFUSED       {ref_s} — replicas "
+                               f"kept their current weights"))
+        sw = snap.get("metrics", {}).get("hvd_fleet_swap_seconds")
+        if sw and sw.get("values"):
+            bounds = sw.get("buckets", [])
+            by_phase = {v.get("labels", {}).get("phase", "?"): v
+                        for v in sw["values"]}
+            for phase in ("detect_to_loaded", "loaded_to_armed",
+                          "armed_to_swapped", "total"):
+                v = by_phase.get(phase)
+                if not v:
+                    continue
+                counts = v.get("counts", [])
+                sp50 = hvd_metrics.histogram_quantile(bounds, counts,
+                                                      0.5)
+                sp99 = hvd_metrics.histogram_quantile(bounds, counts,
+                                                      0.99)
+                lines.append(f"    {phase:<17} p50 {_fmt_s(sp50):>8}"
+                             f"   p99 {_fmt_s(sp99):>8}")
+
     # tracing plane: per-stage span latency + the slow-span tail
     span_entry = snap.get("metrics", {}).get("hvd_span_seconds")
     slow = [e for e in snap.get("events", [])
@@ -663,6 +707,28 @@ def canned_snapshot():
                      ("scheduler_stall", 0.004)):
         for _ in range(60):
             ph.labels(phase=phase).observe(v)
+    reg.gauge("hvd_fleet_published_generation", "g").set(18)
+    reg.counter("hvd_fleet_publishes_total", "c").inc(18)
+    reg.counter("hvd_fleet_swaps_total", "c").inc(16)
+    fg = reg.gauge("hvd_fleet_generation", "g", labels=("replica",))
+    fg.labels(replica="0").set(18)
+    fg.labels(replica="1").set(17)
+    fi = reg.gauge("hvd_fleet_swap_in_progress", "g",
+                   labels=("replica",))
+    fi.labels(replica="0").set(0)
+    fi.labels(replica="1").set(1)
+    fl = reg.gauge("hvd_fleet_last_swap_seconds", "g",
+                   labels=("replica",))
+    fl.labels(replica="0").set(0.81)
+    fr = reg.counter("hvd_fleet_refusals_total", "c",
+                     labels=("reason",))
+    fr.labels(reason="corrupt").inc(1)
+    fs = reg.histogram("hvd_fleet_swap_seconds", "h", labels=("phase",))
+    for phase, v in (("detect_to_loaded", 0.62),
+                     ("loaded_to_armed", 0.14),
+                     ("armed_to_swapped", 0.05), ("total", 0.81)):
+        for _ in range(16):
+            fs.labels(phase=phase).observe(v)
     reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",
               trace_id="r1.42", dur_ms=412.5, status="ok")
     reg.event("serve_reject", request_id="req-9917", reason="queue_full",

@@ -1140,6 +1140,62 @@ def check_adhoc_request_timer(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD015 — ad-hoc weight loading in the serving plane
+# ---------------------------------------------------------------------------
+
+# checkpoint/param-load entry points that put weights into a serving
+# process without the fleet plane's verify-then-arm protocol
+_WEIGHT_LOAD_CALLS = {"restore", "restore_with_extra", "load", "resume"}
+_WEIGHT_LOAD_RECEIVERS = {"checkpoint", "hvd_checkpoint", "ckpt",
+                          "manager", "np", "numpy", "onp", "jnp",
+                          "torch"}
+_WEIGHT_PLANE_DIRS = ("horovod_tpu/serving/", "horovod_tpu/fleet/")
+_SUBSCRIBER_LAYER = "fleet/subscriber.py"
+
+
+def check_adhoc_weight_load(ctx, shared):
+    if "serve_path" not in ctx.roles and not any(
+            d in ctx.relpath for d in _WEIGHT_PLANE_DIRS):
+        return
+    if ctx.relpath.endswith(_SUBSCRIBER_LAYER):
+        return  # the one sanctioned weight-load path
+    # `from ...checkpoint import restore` aliases
+    aliases = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.rsplit(".", 1)[-1] == "checkpoint":
+            for a in node.names:
+                if a.name in _WEIGHT_LOAD_CALLS:
+                    aliases.add(a.asname or a.name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        hit = ((chain is not None and len(chain) >= 2 and
+                chain[-1] in _WEIGHT_LOAD_CALLS and
+                chain[-2] in _WEIGHT_LOAD_RECEIVERS) or
+               (isinstance(node.func, ast.Name) and
+                node.func.id in aliases))
+        if not hit:
+            continue
+        call = ".".join(chain) if chain else node.func.id
+        yield Finding(
+            "HVD015", ctx.relpath, node.lineno, node.col_offset,
+            f"ad-hoc weight load '{call}(...)' in the serving plane, "
+            "outside the WeightSubscriber: a direct checkpoint/param "
+            "load skips the fleet plane's verify-then-arm protocol — "
+            "no checksum verification before the tree is visible (a "
+            "corrupt shard reaches decode), no double buffering (a "
+            "half-loaded tree can serve a step), no generation id (the "
+            "tokens it produces are unattributable), no refusal path "
+            "(a bad publish takes the replica down instead of being "
+            "refused loudly). Route weight ingestion through "
+            "fleet.WeightSubscriber — load_initial() at startup, "
+            "poll()/take_armed() for hot swaps — so every tree that "
+            "reaches the engine rode the docs/fleet.md state machine.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1529,5 +1585,43 @@ reason naming the SLO instrument on the shared registry that consumes
 it (the engine's TTFT/intertoken histograms and the deadline checks
 are the baselined examples).""",
             check_adhoc_request_timer),
+        Rule(
+            "HVD015", "adhoc-weight-load",
+            "direct checkpoint/param loads in the serving plane "
+            "outside the WeightSubscriber",
+            """HVD015 — ad-hoc weight loading in the serving plane
+
+The fleet plane gives serving weights exactly one front door:
+``fleet/subscriber.py``. A ``WeightSubscriber`` watches the
+publication pointer, background-loads new generations off the decode
+hot path, checksum-verifies every file BEFORE the tree becomes
+visible, double-buffers so the engine never touches a half-loaded
+tree, stamps the monotonic generation id every token gets attributed
+to, and refuses corrupt or mismatched publishes loudly (fleet_refuse
+event + hvd_fleet_refusals_total) while the old generation keeps
+serving (docs/fleet.md).
+
+A direct ``checkpoint.restore(...)`` / ``np.load(...)`` anywhere else
+under ``horovod_tpu/serving/`` or ``horovod_tpu/fleet/`` bypasses all
+of that: it blocks the step loop for the full deserialize, hands the
+engine a tree no checksum vouched for, produces tokens no generation
+id can attribute, and turns a bad publish into a replica crash
+instead of a refusal. The historical shape this rule pins: replicas
+loading weights once at startup with a bare restore — the exact
+pattern the fleet plane replaced.
+
+Flags calls whose attribute chain ends in restore /
+restore_with_extra / load / resume on a checkpoint-ish or array-
+library receiver (checkpoint, ckpt, manager, np, jnp, torch, ...),
+plus bare-name aliases imported from a checkpoint module. Scope:
+``horovod_tpu/serving/`` and ``horovod_tpu/fleet/`` (fixtures opt in
+with ``# hvdlint: role=serve_path``); ``fleet/subscriber.py`` itself
+is the sanctioned layer.
+
+Fix: take weights from the replica's WeightSubscriber
+(``load_initial()`` at startup, the engine's ``_maybe_swap`` for hot
+swaps); keep a direct load only with a disable reason naming why the
+verify-then-arm protocol cannot apply.""",
+            check_adhoc_weight_load),
     ]
 }
