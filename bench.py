@@ -609,6 +609,242 @@ def _bench_quant(hvd, on_tpu):
     return out
 
 
+def _bench_overlap(hvd, on_tpu):
+    """Backward/comm overlap A/B gate (docs/tensor-fusion.md): the SAME
+    real eager LM step (bench_common.build_eager_lm_step, the exact
+    path users run with --eager-allreduce) with the barrier gradient
+    path vs HOROVOD_OVERLAP_EAGER's readiness-ordered bucket dispatch,
+    toggled live through the coordinator's config. Arm order is
+    counterbalanced across rounds with an untimed toggle-warmup step —
+    the r5 interleaved protocol, so machine drift is common-mode.
+
+    Enforced (AssertionError, same contract family as the quant gate):
+
+      * the mechanism engaged: hvd_overlap_ready_flushes_total must
+        advance during the overlap arm's timed windows — buckets
+        really dispatched inside the enqueue window, not at the drain;
+      * exposed_comm_ms down: the framework's own dispatch timing
+        (optim.py's hvd_grad_exposed_ms_total — wall spent draining
+        collectives AFTER the last grad enqueue) must be strictly lower
+        per step with overlap on;
+      * tokens/s: on TPU the overlap arm must match or beat the
+        barrier arm — device comm is real there and hiding it must
+        pay. On CPU smoke the number is REPORTED, not enforced: the
+        collectives run inline on the enqueuing thread, so there is no
+        concurrent comm to hide and the wall delta is pure machine
+        drift (measured -10%..+36% across identical back-to-back
+        runs) — a CPU wall gate would gate on noise.
+
+    overlap_frac is 1 - exposed_on/exposed_off: the fraction of the
+    barrier path's formerly-exposed comm now hidden inside the enqueue
+    window. The fusion threshold is pinned (both arms identically) to
+    ~1/8 of the gradient payload so the step spans several fusion
+    groups — the regime the dispatcher exists for; one giant bucket
+    would measure nothing either way.
+
+    A hierarchical wire-leg drill rides along: a 2-process int8 run
+    with overlap_local_size=1 whose per-leg byte counters
+    (hvd_wire_leg_bytes_total) must show the codec on the inter-host
+    leg ONLY. On backends without cross-process collectives (the CPU
+    smoke box) the drill records itself skipped; when the parent run
+    is itself multi-process with hierarchy on, the parent's own
+    counters are checked instead."""
+    import time
+
+    import jax
+
+    import horovod_tpu.common.state as state
+    from bench_common import build_eager_lm_step, flagship_config
+    from horovod_tpu.utils import metrics as hvd_metrics
+
+    coord = state.global_state().coordinator
+    cfg = coord._config
+    reg = hvd_metrics.get_registry()
+    orig = (cfg.overlap_eager, cfg.fusion_threshold, cfg.cycle_time_ms)
+
+    if on_tpu:
+        t_cfg = flagship_config(True, num_layers=4)
+        bps, seq, steps, rounds = 4, 512, 6, 3
+    else:
+        t_cfg = flagship_config(False)
+        bps, seq, steps, rounds = 2, 64, 3, 2
+    world = hvd.size()
+    arms = ("barrier", "overlap")
+
+    def counters(mode):
+        m = reg.snapshot(max_events=0).get("metrics", {})
+
+        def total(fam_name, **want):
+            fam = m.get(fam_name) or {"values": []}
+            return sum(float(v["value"]) for v in fam["values"]
+                       if all(v["labels"].get(k) == s
+                              for k, s in want.items()))
+
+        return (total("hvd_grad_exposed_ms_total", mode=mode),
+                total("hvd_grad_reduce_steps_total", mode=mode),
+                total("hvd_overlap_ready_flushes_total"))
+
+    out = {"world": world, "steps_per_window": steps, "rounds": rounds,
+           "arms": {}}
+    try:
+        # Park the background cycle for BOTH arms: flush_ready and the
+        # synchronize-side flush become the only dispatchers, so bucket
+        # compositions are deterministic run to run. Racing the 5ms
+        # cycle thread instead lands novel compositions (= fresh jit
+        # compiles) inside timed windows — measured 2-10x step noise.
+        cfg.cycle_time_ms = 10_000.0
+        time.sleep(0.05)  # let the loop re-read the period
+        step, params, opt, toks = build_eager_lm_step(t_cfg, world, bps,
+                                                      seq)
+        grad_nbytes = sum(int(l.nbytes) for l in
+                          jax.tree_util.tree_leaves(params)) * world
+        cfg.fusion_threshold = max(64 << 10, grad_nbytes // 8)
+        out["fusion_threshold"] = int(cfg.fusion_threshold)
+        out["grad_mb"] = round(grad_nbytes / 2**20, 2)
+
+        best = {a: float("inf") for a in arms}
+        best_exposed = {a: float("inf") for a in arms}
+        flushes = {a: 0.0 for a in arms}
+        for rd in range(rounds):
+            for a in (arms if rd % 2 == 0 else arms[::-1]):
+                cfg.overlap_eager = (a == "overlap")
+                # untimed toggle warmup: plan rebuild + compiles
+                params, opt, loss = step(params, opt, toks)
+                float(loss)
+                e0, n0, f0 = counters(a)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    params, opt, loss = step(params, opt, toks)
+                float(loss)
+                best[a] = min(best[a],
+                              (time.perf_counter() - t0) / steps * 1e3)
+                e1, n1, f1 = counters(a)
+                # per-window best-of-min, same protocol as the wall
+                # number: a slow straggler window (cache churn, GC)
+                # otherwise contaminates an average the wall's min
+                # already filtered out
+                best_exposed[a] = min(best_exposed[a],
+                                      (e1 - e0) / max(n1 - n0, 1.0))
+                flushes[a] += f1 - f0
+
+        tok = {}
+        for a in arms:
+            tok[a] = world * bps * seq / (best[a] / 1e3)
+            out["arms"][a] = {
+                "best_step_ms": round(best[a], 3),
+                "exposed_comm_ms_per_step": round(best_exposed[a], 3),
+                "tokens_per_sec": round(tok[a], 1)}
+        exp_off, exp_on = best_exposed["barrier"], best_exposed["overlap"]
+        overlap_frac = max(0.0, 1.0 - exp_on / max(exp_off, 1e-9))
+        gain_pct = (tok["overlap"] / tok["barrier"] - 1) * 100
+        out.update({
+            "ready_flushes": int(flushes["overlap"]),
+            "overlap_frac": round(overlap_frac, 4),
+            "exposed_comm_ms_off": round(exp_off, 3),
+            "exposed_comm_ms_on": round(exp_on, 3),
+            "tokens_gain_pct": round(gain_pct, 2)})
+        assert flushes["overlap"] >= 1, (
+            f"overlap arm never ready-flushed a bucket — dispatch "
+            f"stayed at the drain: {out}")
+        assert exp_on < exp_off, (
+            f"exposed comm did not drop with overlap on "
+            f"({exp_on:.3f}ms vs {exp_off:.3f}ms per step): {out}")
+        if on_tpu:
+            assert tok["overlap"] >= tok["barrier"], (
+                f"overlap arm lost {-gain_pct:.1f}% tokens/s on "
+                f"hardware with real device comm to hide: {out}")
+        else:
+            out["tokens_gate"] = ("report-only on CPU smoke: no "
+                                  "asynchronous device comm exists to "
+                                  "hide, so dispatch overhead is all "
+                                  "the arm can measure")
+    finally:
+        cfg.overlap_eager, cfg.fusion_threshold, cfg.cycle_time_ms = orig
+
+    out["hierarchical"] = _overlap_hier_drill(cfg, reg)
+    return out
+
+
+def _overlap_hier_drill(cfg, reg):
+    """Wire-leg proof for the two-level reduction: the quantized codec
+    must account bytes on the inter-host leg ONLY (the intra-host legs
+    run full-width). In-process when the ambient run is already
+    multi-process with hierarchy on; otherwise a 2-process launch.run
+    drill, recorded as skipped on backends without cross-process
+    collectives. Enforces (AssertionError) whenever counters land."""
+    import jax
+
+    def judge(legs):
+        inter = sum(v for k, v in legs.items()
+                    if k.startswith("inter/") and
+                    not k.endswith("/none"))
+        intra_q = {k: v for k, v in legs.items()
+                   if k.startswith("intra/") and not k.endswith("/none")
+                   and v > 0}
+        assert not intra_q, (
+            f"quantized codec accounted on an intra-host leg: {legs}")
+        assert inter > 0, (
+            f"no quantized bytes accounted on the inter-host leg: "
+            f"{legs}")
+        return {"legs": legs, "inter_quantized_bytes": int(inter)}
+
+    def leg_totals(snapshot):
+        fam = snapshot.get("metrics", {}).get(
+            "hvd_wire_leg_bytes_total") or {"values": []}
+        return {f"{v['labels'].get('leg')}/{v['labels'].get('codec')}":
+                float(v["value"]) for v in fam.get("values", [])}
+
+    if jax.process_count() > 1 and getattr(cfg, "overlap_hierarchical",
+                                           False):
+        legs = leg_totals(reg.snapshot(max_events=0))
+        if legs:
+            return judge(legs)
+        return {"skipped": "hierarchy on but no leg bytes accounted "
+                           "(no quantized codec negotiated?)"}
+
+    def fn():
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu.utils import metrics as hvd_metrics
+
+        hvd_metrics.reset(enabled=True)
+        hvd.init()
+        r = hvd.rank()
+        for i in range(3):
+            x = np.full((4096,), float(r + 1 + i), np.float32)
+            np.asarray(hvd.allreduce(x, average=False,
+                                     name=f"ovl.hier.{i}"))
+        snap = hvd_metrics.get_registry().snapshot(max_events=0)
+        hvd.shutdown()
+        fam = snap.get("metrics", {}).get(
+            "hvd_wire_leg_bytes_total") or {"values": []}
+        return {f"{v['labels'].get('leg')}/{v['labels'].get('codec')}":
+                float(v["value"]) for v in fam.get("values", [])}
+
+    from horovod_tpu.run.launch import run as hvd_run
+    env = {"JAX_PLATFORMS": jax.devices()[0].platform,
+           "PALLAS_AXON_POOL_IPS": "",
+           "HOROVOD_COMPRESSION": "int8",
+           "HOROVOD_QUANT_MIN_BYTES": "0",
+           "HOROVOD_OVERLAP_HIERARCHICAL": "1",
+           "HOROVOD_OVERLAP_LOCAL_SIZE": "1"}
+    try:
+        legs_by_rank = hvd_run(fn, num_proc=2, env=env,
+                               start_timeout_s=300.0)
+    except RuntimeError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            return {"skipped": "backend has no cross-process "
+                               "collectives (CPU smoke box); the drill "
+                               "enforces on real pods"}
+        return {"error": str(e)[:200]}
+    merged = {}
+    for legs in legs_by_rank:
+        for k, v in legs.items():
+            merged[k] = merged.get(k, 0.0) + v
+    return judge(merged)
+
+
 def _bench_ckpt(steps=12, rounds=4, save_every=4, target_step_ms=100.0,
                 budget_pct=2.0, mb=2.0):
     """Checkpoint-plane overhead contract (docs/checkpoint.md): async
@@ -1204,6 +1440,14 @@ def main():
     quant = None
     if os.environ.get("HVD_BENCH_QUANT", "") != "0":
         quant = _bench_quant(hvd, on_tpu)
+    # Overlap A/B gate: barrier vs readiness-ordered bucket dispatch on
+    # the real eager LM step — ready flushes engaged, exposed comm down,
+    # tokens/s within drift — plus the hierarchical wire-leg drill
+    # (int8 on the inter-host leg only). Enforced (AssertionError);
+    # HVD_BENCH_OVERLAP=0 skips it.
+    overlap = None
+    if os.environ.get("HVD_BENCH_OVERLAP", "") != "0":
+        overlap = _bench_overlap(hvd, on_tpu)
     # Serving A/B gate: continuous vs static batching on the same
     # engine under Poisson load; tokens/step >=1.5x is ENFORCED, TTFT
     # p50/p99 ride along. HVD_BENCH_SERVE=0 skips it.
@@ -1396,6 +1640,7 @@ def main():
         "flight_recorder": flight,
         "numerics": numerics,
         "quant": quant,
+        "overlap": overlap,
         "serve": serve,
         "swap": swap,
         "ckpt": ckpt,

@@ -62,6 +62,17 @@ echo "--- quantization kernels (fast fail: block encode/decode, EF, codec regist
 # seconds; the multi-process codec-mismatch drill rides the full suite.
 python -m pytest tests/test_quantization.py -q -m "not slow"
 
+echo "--- overlap plane (fast fail: readiness dispatch, bit-for-bit parity, hier wire)"
+# The overlap plane (docs/tensor-fusion.md "Overlap plane") reorders
+# gradient dispatch under HOROVOD_OVERLAP_EAGER and splits the wire
+# under HOROVOD_OVERLAP_HIERARCHICAL; the one invariant that keeps it
+# shippable is fp32 bit-for-bit parity with the barrier path. The fast
+# suite proves seal/partial flush semantics, reverse-order dispatch,
+# exact parity, and the trivial-world hierarchical codec math in
+# seconds; the 2-process parity/int8-leg/chaos drills are @slow and
+# ride the full suite below.
+python -m pytest tests/test_overlap.py -q -m "not slow"
+
 echo "--- serving plane (fast fail: scheduler invariants, KV ledger, SLO metrics)"
 # The serving engine (docs/serving.md) shares the model, metrics and
 # control plane with training but runs its own scheduler + KV-cache

@@ -140,6 +140,20 @@ class HorovodConfig:
     hierarchical_allgather: bool = False
     # Explicit ppermute ring allreduce backend (ops/operation_manager.py).
     ring_allreduce: bool = False
+    # Overlap plane (docs/tensor-fusion.md): dispatch fused gradient
+    # buckets in readiness order while the backward is still producing
+    # later (earlier-layer) grads, instead of one barrier-then-allreduce
+    # over the whole tree. Off by default: the barrier path stays the
+    # reference behavior.
+    overlap_eager: bool = False
+    # Two-level eager reduction: intra-host full-width reduce-scatter,
+    # inter-host allreduce on the negotiated codec, intra-host
+    # broadcast. The quantized wire rides only the inter-host leg.
+    overlap_hierarchical: bool = False
+    # Processes per host for the two-level split. 0 = take the
+    # launcher's HVD_LOCAL_SIZE. Must divide the world size; a split
+    # with only one host (or one process per host) falls back flat.
+    overlap_local_size: int = 0
     # Logging.
     log_level: str = "WARNING"
     log_timestamp: bool = False
@@ -183,6 +197,9 @@ class HorovodConfig:
             hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER", False),
             ring_allreduce=env_bool("RING_ALLREDUCE", False),
+            overlap_eager=env_bool("OVERLAP_EAGER", False),
+            overlap_hierarchical=env_bool("OVERLAP_HIERARCHICAL", False),
+            overlap_local_size=env_int("OVERLAP_LOCAL_SIZE", 0),
             log_level=env_str("LOG_LEVEL", "WARNING") or "WARNING",
             log_timestamp=env_bool("LOG_TIMESTAMP", False),
         )
@@ -308,6 +325,17 @@ ENV_REGISTRY = (
      "digest records."),
     ("HOROVOD_NUMERICS_WARMUP", True, "5", "utils/numerics.py",
      "Per-tensor observations before the norm-spike policy arms."),
+    ("HOROVOD_OVERLAP_EAGER", True, "0", "common/config.py",
+     "Overlap plane: dispatch fused gradient buckets in readiness "
+     "order while backward still produces later grads, instead of one "
+     "barrier-then-allreduce over the whole tree."),
+    ("HOROVOD_OVERLAP_HIERARCHICAL", True, "0", "common/config.py",
+     "Two-level eager reduction: intra-host full-width reduce-scatter, "
+     "inter-host allreduce on the negotiated codec, intra-host "
+     "broadcast; the quantized wire rides only the inter-host leg."),
+    ("HOROVOD_OVERLAP_LOCAL_SIZE", True, "0", "common/config.py",
+     "Processes per host for the two-level reduction split (0 = take "
+     "the launcher's HVD_LOCAL_SIZE; must divide the world size)."),
     ("HOROVOD_QUANT_BLOCK", True, "256", "common/config.py",
      "Elements per block-scaled quantization block (one f32 scale "
      "each)."),
@@ -445,6 +473,10 @@ ENV_REGISTRY = (
      "instrument_step capture amortized <=2% vs attribution off)."),
     ("HVD_BENCH_NUMERICS", False, None, "bench.py",
      "Set 0 to skip the numerics-overhead gate in bench.py."),
+    ("HVD_BENCH_OVERLAP", False, None, "bench.py",
+     "Set 0 to skip the overlap bench leg (barrier vs readiness-"
+     "ordered dispatch on the real eager LM step: overlap_frac, "
+     "exposed dispatch ms, tokens/s, two-level wire-byte split)."),
     ("HVD_BENCH_QUANT", False, None, "bench.py",
      "Set 0 to skip the quantized-wire bench leg (int8 vs bf16 wire "
      "bytes + none-codec overhead gate)."),

@@ -50,11 +50,16 @@ def _bound_axis_names():
         return []
 
 
-def resolve_axis(axis_name=None):
+def resolve_axis(axis_name=None, prefer_hierarchy=False):
     """Pick the collective axis: explicit > traced mesh axis > None (eager).
     ``axis_name`` may be a tuple of axes (a reduction spanning a whole
     hierarchy, e.g. ("slices", "chips")) — resolved iff every member is
-    bound."""
+    bound. ``prefer_hierarchy`` (the allreduce entry points) resolves a
+    None axis to the full hierarchy pair when both axes are bound and
+    HOROVOD_HIERARCHICAL_ALLREDUCE is on, so OperationManager's
+    two-level backend — which matches on the exact pair — can actually
+    win; single-axis ops (broadcast's axis_index, allgather) never get
+    the tuple."""
     bound = _bound_axis_names()
     if isinstance(axis_name, (tuple, list)):
         return tuple(axis_name) if all(a in bound for a in axis_name) \
@@ -64,7 +69,13 @@ def resolve_axis(axis_name=None):
     if not bound:
         return None
     if state_mod.is_initialized():
-        for n in state_mod.global_state().mesh.axis_names:
+        state = state_mod.global_state()
+        if prefer_hierarchy and getattr(
+                state.config, "hierarchical_allreduce", False):
+            from .operation_manager import HIER_FAST_AXIS, HIER_SLOW_AXIS
+            if HIER_FAST_AXIS in bound and HIER_SLOW_AXIS in bound:
+                return (HIER_FAST_AXIS, HIER_SLOW_AXIS)
+        for n in state.mesh.axis_names:
             if n in bound:
                 return n
     return bound[0]
@@ -143,7 +154,7 @@ def allreduce_traced(tensor, average=True, axis_name=None, op=None,
     horovod/tensorflow/__init__.py:36-83: compress → sum → decompress →
     divide by size when averaging).
     """
-    axis = resolve_axis(axis_name)
+    axis = resolve_axis(axis_name, prefer_hierarchy=True)
     assert axis is not None, "allreduce_traced requires a bound mesh axis"
     _count_traced("allreduce", [tensor])
     op = op or (AVERAGE if average else SUM)
@@ -180,7 +191,7 @@ def grouped_allreduce_traced(tensors, average=True, axis_name=None,
     """Fused allreduce of a list/pytree of tensors: one psum per fusion
     bucket (reference FuseResponses, operations.cc:450-573)."""
     from . import fusion as fusion_mod
-    axis = resolve_axis(axis_name)
+    axis = resolve_axis(axis_name, prefer_hierarchy=True)
     assert axis is not None
     if fusion_threshold is None:
         fusion_threshold = state_mod.global_state().config.fusion_threshold \

@@ -220,6 +220,12 @@ class EagerCoordinator:
             getattr(self._config, "coordinator_lost_timeout_seconds", 0.0)
             or self.POISON_GRACE_S)
         self._paused = False  # test hook: lets stall detection be exercised
+        # Overlap plane (docs/tensor-fusion.md): flush_ready() drains
+        # fusion buckets that filled while the caller is still enqueuing
+        # later tensors; the event makes the background cycle's pacing
+        # interruptible so a filled bucket dispatches now instead of
+        # waiting out the cycle sleep.
+        self._ready_event = threading.Event()
         self._stall_warned = set()
         self._verified_sigs = set()  # cross-process checks done (signature)
         self.timeline = timeline_mod.create_from_env(
@@ -378,6 +384,18 @@ class EagerCoordinator:
             "Dispatch latency of one eager collective execution "
             "(async: completion happens on device), by op class.",
             labels=("op",))
+        self._m_overlap_flushes = reg.counter(
+            "hvd_overlap_ready_flushes_total",
+            "Ready-bucket drains dispatched while the caller was still "
+            "enqueuing later tensors (overlap plane).")
+        self._m_overlap_tensors = reg.counter(
+            "hvd_overlap_ready_tensors_total",
+            "Tensors dispatched by ready-bucket drains ahead of the "
+            "whole-tree barrier.")
+        self._m_overlap_wakes = reg.counter(
+            "hvd_overlap_wakes_total",
+            "Early background-cycle wakes requested by flush_ready "
+            "(negotiated path: a bucket's worth of bytes is queued).")
         self._m_stalled_tensors = reg.gauge(
             "hvd_stalled_tensors",
             "Pending tensors on this worker past the stall warning "
@@ -506,7 +524,11 @@ class EagerCoordinator:
 
     def _background_loop(self):
         while not self._shutdown:
-            time.sleep(self._config.cycle_time_ms / 1000.0)
+            # interruptible pacing: flush_ready() sets the event when a
+            # fusion bucket fills, so its collective dispatches now
+            # instead of waiting out the rest of the cycle sleep
+            self._ready_event.wait(self._config.cycle_time_ms / 1000.0)
+            self._ready_event.clear()
             if self._paused:
                 continue
             try:
@@ -533,28 +555,7 @@ class EagerCoordinator:
             self._queue.clear()
         if not batch:
             return
-        if self.timeline:
-            self.timeline.mark_cycle_start()
-            for e in batch:
-                self.timeline.negotiate_end(e.name)
-        for e in batch:
-            # single-process: negotiation is a local queue wait
-            if e.span is not None:
-                e.span.close(local=True)
-        t0 = time.perf_counter()
-        # the plan depends on the (possibly autotuned) fusion threshold
-        # and on the codec knobs (the bench toggles compression live)
-        key = (int(self._config.fusion_threshold),
-               quant_mod.config_fingerprint(self._config),
-               tuple(e.signature() for e in batch))
-        plan = self.plan_cache.get(key)
-        if plan is None:
-            plan = self._make_plan(batch)
-            self.plan_cache.put(key, plan)
-        self._adopted_this_flush = False
-        self._execute(batch, plan)
-        self._m_flush_s.observe(time.perf_counter() - t0)
-        self._m_flush_tensors.observe(len(batch))
+        t0 = self._run_batch(batch)
         if (self.autotuner is not None
                 and not self.autotuner.frozen
                 and not self._autotune_pending_adoption):
@@ -591,6 +592,111 @@ class EagerCoordinator:
                             self.autotuner.threshold)
                         self._config.cycle_time_ms = float(
                             self.autotuner.cycle_time_ms)
+
+    def _run_batch(self, batch):
+        """Plan + execute one drained batch — the body of a
+        non-negotiated cycle, shared by the whole-queue flush and the
+        overlap plane's ready-bucket drains. Returns the flush start
+        time (the autotune scorer's window anchor). Caller holds
+        _flush_lock."""
+        if self.timeline:
+            self.timeline.mark_cycle_start()
+            for e in batch:
+                self.timeline.negotiate_end(e.name)
+        for e in batch:
+            # single-process: negotiation is a local queue wait
+            if e.span is not None:
+                e.span.close(local=True)
+        t0 = time.perf_counter()
+        # the plan depends on the (possibly autotuned) fusion threshold
+        # and on the codec knobs (the bench toggles compression live)
+        key = (int(self._config.fusion_threshold),
+               quant_mod.config_fingerprint(self._config),
+               tuple(e.signature() for e in batch))
+        plan = self.plan_cache.get(key)
+        if plan is None:
+            plan = self._make_plan(batch)
+            self.plan_cache.put(key, plan)
+        self._adopted_this_flush = False
+        self._execute(batch, plan)
+        self._m_flush_s.observe(time.perf_counter() - t0)
+        self._m_flush_tensors.observe(len(batch))
+        return t0
+
+    def flush_ready(self):
+        """Overlap plane: dispatch every fusion bucket that has FILLED,
+        without waiting for the whole-tree barrier or the cycle pacing.
+        Callers (optim's reverse-order gradient enqueue) invoke this
+        between enqueues so a full bucket's collective starts while
+        later (earlier-layer) grads are still being submitted. Partial
+        groups always stay queued for the normal cycle. Under
+        negotiation only the background thread may originate data-plane
+        collectives (single-origin ordering), so this wakes its cycle
+        immediately instead of draining inline. No-op unless
+        HOROVOD_OVERLAP_EAGER is on."""
+        if self._shutdown or self._paused:
+            return
+        if not getattr(self._config, "overlap_eager", False):
+            return
+        if self._negotiator is not None:
+            threshold = int(self._config.fusion_threshold)
+            with self._queue_lock:
+                queued = sum(_entry_nbytes(e) for e in self._queue
+                             if e.op == ALLREDUCE)
+            if queued and (threshold <= 0 or queued >= threshold):
+                self._m_overlap_wakes.inc()
+                self._ready_event.set()
+            return
+        if not self._flush_lock.acquire(False):
+            return  # a cycle is already draining; it takes the queue
+        try:
+            with self._queue_lock:
+                batch = self._take_ready_locked()
+            if not batch:
+                return
+            self._m_overlap_flushes.inc()
+            self._m_overlap_tensors.inc(len(batch))
+            self._run_batch(batch)
+        finally:
+            self._flush_lock.release()
+
+    def _take_ready_locked(self):
+        """Remove and return every queued entry belonging to a fusion
+        group whose accumulated bytes crossed the fusion threshold.
+        Groups are keyed exactly like _make_plan's bucketing, so a
+        drained group plans into at least one full bucket; partial
+        groups and non-allreduce ops stay queued in submission order.
+        Deterministic given the same program + config, so multi-process
+        (non-negotiated) drains stay matched across ranks. Caller holds
+        _queue_lock."""
+        threshold = int(self._config.fusion_threshold)
+        if threshold <= 0 or not self._queue:
+            return []
+        world = max(self._world, 1)
+        group_bytes = {}
+        keys = []
+        for e in self._queue:
+            if e.op != ALLREDUCE or e.kind == "list":
+                keys.append(None)
+                continue
+            nb = _entry_nbytes(e)
+            per_rank = nb // world if e.kind == "stacked" else nb
+            codec = quant_mod.select_codec(
+                self._config, getattr(e.tensor, "dtype", None), per_rank)
+            key = (e.kind, str(getattr(e.tensor, "dtype", None)),
+                   e.average, codec)
+            keys.append(key)
+            group_bytes[key] = group_bytes.get(key, 0) + nb
+        ready = {k for k, b in group_bytes.items() if b >= threshold}
+        if not ready:
+            return []
+        batch = []
+        keep = collections.deque()
+        for e, key in zip(self._queue, keys):
+            (batch if key in ready else keep).append(e)
+        self._queue.clear()
+        self._queue.extend(keep)
+        return batch
 
     def _make_plan(self, batch):
         """Group fusable entries (stacked allreduces by dtype/average), one
@@ -1102,6 +1208,36 @@ class EagerCoordinator:
         from .process_collectives import ProcessCollectiveEngine
         return ProcessCollectiveEngine()
 
+    @functools.cached_property
+    def _hier_engine(self):
+        """Two-level [hosts, local] engine for eager fused allreduces,
+        or None when the split is off or degenerate. Eligible when the
+        knob is on, the world is multi-process, local_size (config, or
+        the launcher's HVD_LOCAL_SIZE) divides it, and more than one
+        host remains — a single-host "split" is the flat engine with
+        extra steps. local_size=1 is legal: every process is its own
+        host and the codec rides the full inter-host exchange, which is
+        how 2-process tests exercise the hierarchy."""
+        if not getattr(self._config, "overlap_hierarchical", False):
+            return None
+        nproc = jax.process_count()
+        if nproc <= 1:
+            return None
+        local = int(getattr(self._config, "overlap_local_size", 0)) or \
+            state_mod.process_local_size()
+        if local < 1 or nproc % local or nproc // local <= 1:
+            log.warning(
+                "hierarchical reduction disabled: local_size %d gives "
+                "no multi-host split of %d processes", local, nproc)
+            return None
+        from .process_collectives import HierarchicalProcessEngine
+        try:
+            return HierarchicalProcessEngine(local)
+        except Exception as exc:  # topology probe, not control flow
+            log.warning("hierarchical engine unavailable, falling back "
+                        "flat: %s", exc)
+            return None
+
     def _exec_fused_replicated_allreduce(self, entries, average,
                                          codec=None):
         """Coordinator-fused multi-process allreduce: one flattened
@@ -1128,29 +1264,61 @@ class EagerCoordinator:
             block = int(getattr(self._config, "quant_block",
                                 quant_mod.BLOCK_DEFAULT))
             ef_on = bool(getattr(self._config, "quant_ef", True))
-            key = "|".join(names)
             total = int(fused.shape[0])
-            comp = self._ef.compensate(key, fused) if ef_on else fused
-            nproc = jax.process_count()
-            payload, scales = quant_mod.encode(
-                comp, block, codec, multiple=block * nproc)
-            with jax.profiler.TraceAnnotation(
-                    f"hvd.quantized_allreduce.{codec}.x{len(entries)}"):
-                summed = self._proc_engine.allreduce_quantized(
-                    payload, scales, codec, block,
-                    average=average)[:total].astype(fused.dtype)
-            # this rank's own wire contribution as the peers saw it —
-            # the error-feedback reference and the numerics plane's
-            # post-compression side
-            dec_own = quant_mod.decode(payload, scales, block, total)
-            if ef_on:
-                self._ef.update(key, comp, dec_own, block,
-                                anchor=names[0])
-            quant_mod.account(codec, fused.nbytes,
-                              quant_mod.wire_nbytes(payload, scales))
-            mon = hvd_numerics.get_monitor()
-            if mon.enabled:
-                mon.observe_compression(names[0], comp, dec_own, codec)
+            hier = self._hier_engine
+            if hier is not None:
+                # Two-level path: the intra-host legs (reduce-scatter
+                # in, all-gather out) stay full-width; only this
+                # process's 1/local_size shard crosses hosts encoded.
+                # EF is keyed per-shard (#hier suffix) because the
+                # residual lives at shard, not buffer, length.
+                key = "|".join(names) + "#hier"
+                shard_len = quant_mod.pad_to(
+                    total, block * hier.nproc) // hier.local_size
+                residual = (self._ef.peek(key, (shard_len,))
+                            if ef_on else None)
+                with jax.profiler.TraceAnnotation(
+                        f"hvd.hier_allreduce.{codec}.x{len(entries)}"):
+                    full, comp, dec_own = hier.allreduce_quantized(
+                        fused, codec, block, average=average,
+                        residual=residual)
+                summed = full[:total].astype(fused.dtype)
+                if ef_on:
+                    self._ef.update(key, comp, dec_own, block,
+                                    anchor=names[0])
+                wire_inter = quant_mod.encoded_nbytes(
+                    shard_len, codec, block)
+                quant_mod.account(codec, fused.nbytes, wire_inter)
+                quant_mod.account_leg("intra", None, fused.nbytes)
+                quant_mod.account_leg("inter", codec, wire_inter)
+                mon = hvd_numerics.get_monitor()
+                if mon.enabled:
+                    mon.observe_compression(names[0], comp, dec_own,
+                                            codec)
+            else:
+                key = "|".join(names)
+                comp = self._ef.compensate(key, fused) if ef_on else fused
+                nproc = jax.process_count()
+                payload, scales = quant_mod.encode(
+                    comp, block, codec, multiple=block * nproc)
+                with jax.profiler.TraceAnnotation(
+                        f"hvd.quantized_allreduce.{codec}.x{len(entries)}"):
+                    summed = self._proc_engine.allreduce_quantized(
+                        payload, scales, codec, block,
+                        average=average)[:total].astype(fused.dtype)
+                # this rank's own wire contribution as the peers saw it
+                # — the error-feedback reference and the numerics
+                # plane's post-compression side
+                dec_own = quant_mod.decode(payload, scales, block, total)
+                if ef_on:
+                    self._ef.update(key, comp, dec_own, block,
+                                    anchor=names[0])
+                quant_mod.account(codec, fused.nbytes,
+                                  quant_mod.wire_nbytes(payload, scales))
+                mon = hvd_numerics.get_monitor()
+                if mon.enabled:
+                    mon.observe_compression(names[0], comp, dec_own,
+                                            codec)
         elif codec is not None:
             wire = fused.astype(quant_mod.wire_dtype(codec))
             with jax.profiler.TraceAnnotation(
@@ -1159,10 +1327,23 @@ class EagerCoordinator:
                     wire, average=average).astype(fused.dtype)
             quant_mod.account(codec, fused.nbytes, wire.nbytes)
         else:
-            with jax.profiler.TraceAnnotation(
-                    f"hvd.fused_allreduce.x{len(entries)}"):
-                summed = self._proc_engine.allreduce(fused, average=average)
-            quant_mod.account(None, fused.nbytes, fused.nbytes)
+            hier = self._hier_engine
+            if hier is not None:
+                with jax.profiler.TraceAnnotation(
+                        f"hvd.hier_allreduce.x{len(entries)}"):
+                    summed = hier.allreduce(
+                        fused, average=average).astype(fused.dtype)
+                quant_mod.account(None, fused.nbytes, fused.nbytes)
+                quant_mod.account_leg("intra", None, fused.nbytes)
+                # full-width shard per process crosses hosts
+                quant_mod.account_leg(
+                    "inter", None, fused.nbytes // hier.local_size)
+            else:
+                with jax.profiler.TraceAnnotation(
+                        f"hvd.fused_allreduce.x{len(entries)}"):
+                    summed = self._proc_engine.allreduce(fused,
+                                                         average=average)
+                quant_mod.account(None, fused.nbytes, fused.nbytes)
         if hvd_numerics.get_monitor().enabled:
             # fused side-product: per-slice health stats in one segment
             # pass over the buffers the collective already materialized;

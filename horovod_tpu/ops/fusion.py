@@ -72,12 +72,31 @@ def plan_buckets(leaves, fusion_threshold):
             b.indices.append(i)
             b.nbytes += sizes[i]
         fspan.annotate(n_buckets=len(order), bytes=sum(sizes))
+    # No silent caps: an oversized tensor bypasses fusion entirely (own
+    # bucket, one unfused collective) — the reference does the same
+    # (operations.cc:466-476) but says nothing, which hides "threshold
+    # too small for this model" behind a mystery collective count.
+    # Surface each occurrence as an event + counter an operator can
+    # alert on.
+    reg = hvd_metrics.get_registry()
+    oversized = [b for b in order
+                 if int(fusion_threshold) > 0 and len(b.indices) == 1
+                 and b.nbytes >= int(fusion_threshold)]
+    if reg.enabled and oversized:
+        reg.counter(
+            "hvd_fusion_oversized_total",
+            "Tensors at or above the fusion threshold that bypassed "
+            "fusion and went out as their own collective.").inc(
+            len(oversized))
+        for b in oversized:
+            reg.event("oversized_tensor", index=b.indices[0],
+                      nbytes=int(b.nbytes),
+                      threshold=int(fusion_threshold))
     # fusion-buffer utilization telemetry: the fill fraction of each
     # planned bucket against the live threshold is the signal the
     # autotuner (and an operator at hvd_top) reads to judge whether the
     # threshold is sized right — mostly-empty buckets mean latency paid
     # for no batching; all-full plus many buckets means it is too small
-    reg = hvd_metrics.get_registry()
     if reg.enabled and order:
         fill = reg.histogram(
             "hvd_fusion_fill_ratio",

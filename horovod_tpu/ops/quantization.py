@@ -206,6 +206,18 @@ class ErrorFeedback:
                 "next step, by fused-bucket anchor tensor.",
                 labels=("tensor",)).labels(tensor=anchor).set(float(norm))
 
+    def peek(self, key, shape=None):
+        """Current residual for ``key`` (or None), optionally requiring
+        an exact shape match — the hierarchical path threads the
+        residual into its jitted collective instead of adding it on the
+        host, so it needs the raw buffer, not ``compensate``'s sum."""
+        with self._lock:
+            r = self._residuals.get(key)
+        if r is not None and shape is not None and tuple(r.shape) != \
+                tuple(shape):
+            return None
+        return r
+
     def reset(self):
         with self._lock:
             self._residuals.clear()
@@ -219,10 +231,18 @@ def config_fingerprint(config):
     decodable — compared by the coordinator every cycle and failed
     loudly on mismatch (negotiation.py)."""
     name = getattr(config, "compression", "none") or "none"
-    return "%s/b%d/min%d/ef%d" % (
+    fp = "%s/b%d/min%d/ef%d" % (
         name, int(getattr(config, "quant_block", BLOCK_DEFAULT)),
         int(getattr(config, "quant_min_bytes", 0)),
         1 if getattr(config, "quant_ef", True) else 0)
+    if getattr(config, "overlap_hierarchical", False):
+        # The two-level split changes what crosses the inter-host wire
+        # (per-host shards, requantized once per phase), so a rank
+        # running flat cannot decode a hierarchical peer's stream. The
+        # suffix only appears when the knob is on, keeping the
+        # fingerprint byte-identical for every existing config.
+        fp += "/h%d" % int(getattr(config, "overlap_local_size", 0))
+    return fp
 
 
 def select_codec(config, dtype, nbytes):
@@ -291,3 +311,21 @@ def account(codec, raw_nbytes, wire_nb):
             "raw/wire byte ratio of the most recent encoded collective "
             "(1.0 when no codec is active).").set(
                 float(raw_nbytes) / float(wire_nb))
+
+
+def account_leg(leg, codec, wire_nb):
+    """Per-leg wire accounting for the two-level reduction: ``leg`` is
+    'intra' (full-width shm traffic inside one host) or 'inter' (the
+    scarce cross-host hop). The overlap bench reads this split to prove
+    the quantized codec rides ONLY the inter-host leg — a nonzero
+    {intra, int8} entry would mean narrow math leaked into the
+    bandwidth-rich local reduction where it buys nothing."""
+    reg = hvd_metrics.get_registry()
+    if not reg.enabled:
+        return
+    reg.counter(
+        "hvd_wire_leg_bytes_total",
+        "Bytes moved per hierarchy leg of the two-level eager "
+        "reduction, by leg (intra|inter) and codec.",
+        labels=("leg", "codec")).labels(
+            leg=leg, codec=codec or "none").inc(int(wire_nb))

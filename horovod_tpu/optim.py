@@ -18,6 +18,8 @@ compute where profitable, which is the compiled-graph equivalent of the
 reference's backward/allreduce overlap (torch/__init__.py:95-130).
 """
 
+import time
+
 import jax
 import optax
 
@@ -25,6 +27,35 @@ from . import mpi_ops
 from .common import state as state_mod
 from .ops import collective_ops as cops
 from .ops.compression import Compression
+from .utils import metrics as hvd_metrics
+
+
+def _account_grad_windows(mode, enqueue_s, drain_s):
+    """Host-side timing of one eager gradient reduction, split into the
+    enqueue window (where overlap dispatch can hide comm) and the final
+    drain (comm still exposed after the last grad exists). The overlap
+    bench leg reads these to compute exposed_comm_ms and overlap_frac
+    from the framework's own dispatch timing rather than re-deriving
+    them outside it."""
+    reg = hvd_metrics.get_registry()
+    if not reg.enabled:
+        return
+    reg.counter(
+        "hvd_grad_reduce_steps_total",
+        "Eager gradient reductions, by dispatch mode.",
+        labels=("mode",)).labels(mode=mode).inc()
+    reg.counter(
+        "hvd_grad_enqueue_ms_total",
+        "Wall ms spent enqueueing gradient collectives (the window "
+        "where readiness-ordered dispatch overlaps comm with grad "
+        "production), by dispatch mode.",
+        labels=("mode",)).labels(mode=mode).inc(enqueue_s * 1e3)
+    reg.counter(
+        "hvd_grad_exposed_ms_total",
+        "Wall ms spent draining gradient collectives after the last "
+        "enqueue — comm the step still pays for serially, by dispatch "
+        "mode.",
+        labels=("mode",)).labels(mode=mode).inc(drain_s * 1e3)
 
 
 def allreduce_gradients(grads, compression=Compression.none, average=True,
@@ -59,10 +90,39 @@ def allreduce_gradients(grads, compression=Compression.none, average=True,
             return cops.grouped_allreduce_traced(
                 dense_leaves, average=average, axis_name=axis_name,
                 compression=compression, fusion_threshold=fusion_threshold)
-        return [mpi_ops.synchronize(h) for h in
-                [mpi_ops.allreduce_async(t, average=average,
-                                         compression=compression)
-                 for t in dense_leaves]]
+        state = state_mod.global_state()
+        coord = getattr(state, "coordinator", None)
+        if coord is not None and getattr(state.config, "overlap_eager",
+                                         False):
+            # Overlap plane (docs/tensor-fusion.md): enqueue in reverse
+            # tree order — the order backward materializes grads — and
+            # drain every fusion bucket that fills while later (earlier-
+            # layer) leaves are still being enqueued, so collective
+            # dispatch rides inside the backward window instead of after
+            # one whole-tree barrier. Results return in original leaf
+            # order; at fp32 the reduction is bitwise identical to the
+            # barrier path (per-element sums are insensitive to bucket
+            # composition and dispatch order).
+            t0 = time.perf_counter()
+            handles = []
+            for t in reversed(dense_leaves):
+                handles.append(mpi_ops.allreduce_async(
+                    t, average=average, compression=compression))
+                coord.flush_ready()
+            t1 = time.perf_counter()
+            out = [mpi_ops.synchronize(h) for h in reversed(handles)]
+            _account_grad_windows("overlap", t1 - t0,
+                                  time.perf_counter() - t1)
+            return out
+        t0 = time.perf_counter()
+        handles = [mpi_ops.allreduce_async(t, average=average,
+                                           compression=compression)
+                   for t in dense_leaves]
+        t1 = time.perf_counter()
+        out = [mpi_ops.synchronize(h) for h in handles]
+        _account_grad_windows("barrier", t1 - t0,
+                              time.perf_counter() - t1)
+        return out
 
     if any(is_sparse):
         dense_out = iter(_dense([l for l, s in zip(leaves, is_sparse)

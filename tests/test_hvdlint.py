@@ -1054,6 +1054,104 @@ def test_hvd015_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD016 — full-tree barrier in the backward→apply window
+# ---------------------------------------------------------------------------
+
+def test_hvd016_triggers_on_synchronize_comprehension(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+
+        def reduce_all(mpi_ops, handles):
+            return [mpi_ops.synchronize(h) for h in handles]
+        """)
+    assert [f.rule for f in live(found)] == ["HVD016"]
+
+
+def test_hvd016_triggers_on_block_until_ready(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+        import jax
+
+        def step(backward, apply, x):
+            grads = backward(x)
+            jax.block_until_ready(grads)
+            return apply(grads)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD016"]
+
+
+def test_hvd016_triggers_in_real_optim_path(tmp_path):
+    mod = tmp_path / "horovod_tpu"
+    mod.mkdir(parents=True)
+    f = mod / "optim.py"
+    f.write_text(textwrap.dedent("""\
+        def drain(mpi_ops, handles):
+            return [mpi_ops.synchronize(h) for h in handles]
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD016"]
+
+
+def test_hvd016_instrument_step_sync_is_sanctioned(tmp_path):
+    # the measurement boundary: instrument_step's own block_until_ready
+    # IS the step wall's definition, not a rival barrier
+    mod = tmp_path / "horovod_tpu"
+    mod.mkdir(parents=True)
+    f = mod / "trainer.py"
+    f.write_text(textwrap.dedent("""\
+        import jax
+
+        def instrument_step(step_fn):
+            def wrapped(*a):
+                out = step_fn(*a)
+                jax.block_until_ready(out)
+                return out
+            return wrapped
+        """))
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert live(findings) == []
+
+
+def test_hvd016_per_bucket_sync_and_cold_paths_are_clean(tmp_path):
+    # a single synchronize as results are consumed is the overlap
+    # plane's OWN idiom; and outside the hot-path scope the barrier is
+    # someone else's call
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+
+        def consume(mpi_ops, handle, apply):
+            return apply(mpi_ops.synchronize(handle))
+        """)
+    assert live(found) == []
+    found = lint_source(tmp_path, """\
+        import jax
+
+        def eval_once(model, x):
+            out = model(x)
+            jax.block_until_ready(out)
+            return [sync(h) for h in out]
+        """)
+    assert live(found) == []
+
+
+def test_hvd016_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=hot_path
+
+        def drain(mpi_ops, handles):
+            # hvdlint: disable=HVD016(checkpoint boundary: every shard must be on host before save)
+            return [mpi_ops.synchronize(h) for h in handles]
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD016"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -1113,7 +1211,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 16)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 17)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

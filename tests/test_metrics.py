@@ -385,6 +385,40 @@ class TestSatelliteInstrumentation:
         assert h.count == 2
         assert h.sum == pytest.approx(1.6)  # 80/100 + 80/100
 
+    def test_fusion_plan_flags_oversized(self, reg):
+        """A tensor at/over the threshold bypasses fusion — that must be
+        loud (event + counter), not a mystery extra collective."""
+        from horovod_tpu.ops import fusion
+        leaves = [np.zeros((50,), np.float32),   # 200 B >= 100
+                  np.zeros((10,), np.float32),
+                  np.zeros((10,), np.float32)]
+        buckets = fusion.plan_buckets(leaves, fusion_threshold=100)
+        assert [b.indices for b in buckets] == [[0], [1, 2]]
+        assert reg.counter("hvd_fusion_oversized_total").value == 1
+        (ev,) = [e for e in reg.events()
+                 if e["event"] == "oversized_tensor"]
+        assert ev["index"] == 0
+        assert ev["nbytes"] == 200
+        assert ev["threshold"] == 100
+        # threshold 0 = fusion disabled BY REQUEST: every tensor rides
+        # alone, and none of that is "oversized"
+        fusion.plan_buckets(leaves, fusion_threshold=0)
+        assert reg.counter("hvd_fusion_oversized_total").value == 1
+        # a bucket exactly filled by several members is not oversized
+        fusion.plan_buckets([np.zeros((20,), np.float32),
+                             np.zeros((5,), np.float32)],
+                            fusion_threshold=100)
+        assert reg.counter("hvd_fusion_oversized_total").value == 1
+
+    def test_fusion_plan_never_mixes_dtypes(self, reg):
+        from horovod_tpu.ops import fusion
+        leaves = [np.zeros((4,), np.float32), np.zeros((4,), np.float16),
+                  np.zeros((4,), np.float32), np.zeros((4,), np.float16)]
+        buckets = fusion.plan_buckets(leaves, fusion_threshold=1 << 20)
+        assert [b.indices for b in buckets] == [[0, 2], [1, 3]]
+        for b in buckets:
+            assert len({str(leaves[i].dtype) for i in b.indices}) == 1
+
     def test_chaos_injection_counts(self, reg):
         from horovod_tpu.run import chaos
         rules = chaos.parse_spec("negotiation:*:drop_request:1.0", seed=7)

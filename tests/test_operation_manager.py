@@ -101,6 +101,74 @@ def test_hierarchical_backend_through_allreduce_traced(hvd):
         got, np.tile(x.mean(0, keepdims=True), (8, 1)), rtol=1e-6)
 
 
+def test_resolve_axis_none_prefers_bound_hierarchy(hvd):
+    """The dispatch gap the docstring promise left open: a traced
+    context that binds BOTH hierarchy axes but passes axis_name=None
+    used to resolve to a single axis, so the hierarchical backend never
+    matched. With the flag on, the allreduce entry points now resolve
+    None to the spanning pair and the two-level backend wins."""
+    from horovod_tpu.ops import collective_ops as cops
+    from horovod_tpu.parallel import mesh as mesh_mod
+
+    m = mesh_mod.build_hierarchical_mesh(num_slices=2)
+    x = np.arange(8.0, dtype=np.float32)
+    cfg = hvd.common.state.global_state().config
+    seen = {}
+
+    def f(t):
+        seen["axis"] = cops.resolve_axis(None, prefer_hierarchy=True)
+        return cops.allreduce_traced(t, average=False)
+
+    def run():
+        return jax.jit(jax.shard_map(
+            f, mesh=m, in_specs=P(("slices", "chips")),
+            out_specs=P(("slices", "chips"))))(x)
+
+    run()
+    # flag off: None resolves to one bound axis, exactly as before
+    assert isinstance(seen["axis"], str)
+    cfg.hierarchical_allreduce = True
+    try:
+        got = np.asarray(run())
+        assert isinstance(seen["axis"], tuple)
+        assert set(seen["axis"]) == {"slices", "chips"}
+        mgr = __import__("horovod_tpu.ops.operation_manager",
+                         fromlist=["om"]).get_operation_manager()
+        assert mgr._select(seen["axis"], ["slices", "chips"],
+                           cfg).name == "hierarchical"
+    finally:
+        cfg.hierarchical_allreduce = False
+    # and the spanning reduction really reduced over the whole world
+    np.testing.assert_allclose(got, np.full(8, x.sum()), rtol=1e-6)
+
+
+def test_hierarchical_selection_emits_reduce_scatter(hvd):
+    """Structural proof of dispatch: with the flag on, the jaxpr of an
+    axis_name=None allreduce under a two-axis mesh contains the
+    two-level schedule's reduce_scatter; with it off, it does not."""
+    from horovod_tpu.ops import collective_ops as cops
+    from horovod_tpu.parallel import mesh as mesh_mod
+
+    m = mesh_mod.build_hierarchical_mesh(num_slices=2)
+    x = np.arange(8.0, dtype=np.float32)
+
+    def f(t):
+        return cops.allreduce_traced(t, average=False)
+
+    def jaxpr_text():
+        return str(jax.make_jaxpr(jax.shard_map(
+            f, mesh=m, in_specs=P(("slices", "chips")),
+            out_specs=P(("slices", "chips"))))(x))
+
+    cfg = hvd.common.state.global_state().config
+    assert "reduce_scatter" not in jaxpr_text()
+    cfg.hierarchical_allreduce = True
+    try:
+        assert "reduce_scatter" in jaxpr_text()
+    finally:
+        cfg.hierarchical_allreduce = False
+
+
 def test_env_knob_parsed(hvd, monkeypatch):
     from horovod_tpu.common.config import HorovodConfig
     monkeypatch.setenv("HOROVOD_RING_ALLREDUCE", "1")

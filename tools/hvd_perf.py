@@ -65,6 +65,8 @@ def _dig(d, path):
 
 _LM_CTX = (("transformer_lm", "model"), ("transformer_lm", "seq_len"),
            ("transformer_lm", "batch_per_chip"))
+_OVERLAP_CTX = (("overlap", "world"), ("overlap", "steps_per_window"),
+                ("overlap", "fusion_threshold"))
 
 LEGS = (
     Leg("resnet50_img_per_sec_per_chip", ("value",),
@@ -81,6 +83,12 @@ LEGS = (
         higher_better=False),
     Leg("ckpt_overhead_pct", ("ckpt", "overhead_pct"),
         higher_better=False),
+    Leg("overlap_frac", ("overlap", "overlap_frac"),
+        context_paths=_OVERLAP_CTX),
+    Leg("overlap_exposed_comm_ms", ("overlap", "exposed_comm_ms_on"),
+        higher_better=False, context_paths=_OVERLAP_CTX),
+    Leg("overlap_tokens_gain_pct", ("overlap", "tokens_gain_pct"),
+        context_paths=_OVERLAP_CTX),
 )
 
 

@@ -1196,6 +1196,75 @@ def check_adhoc_weight_load(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD016 — full-tree barrier between backward and optimizer apply
+# ---------------------------------------------------------------------------
+
+# the modules that own the backward → allreduce → apply window; the
+# overlap plane (docs/tensor-fusion.md) exists so nothing in it drains
+# the whole gradient tree at once
+_BARRIER_SUFFIXES = ("horovod_tpu/trainer.py", "horovod_tpu/optim.py")
+
+
+def check_full_tree_barrier(ctx, shared):
+    if not ("hot_path" in ctx.roles or
+            ctx.relpath.endswith(_BARRIER_SUFFIXES)):
+        return
+    for node in ast.walk(ctx.tree):
+        # idiom 1: [synchronize(h) for h in handles] — drain every
+        # outstanding handle in one comprehension; the whole gradient
+        # tree barriers before the first result is usable
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            elt = node.elt
+            if not isinstance(elt, ast.Call):
+                continue
+            chain = _attr_chain(elt.func)
+            callee = (chain[-1] if chain else
+                      elt.func.id if isinstance(elt.func, ast.Name)
+                      else None)
+            if callee != "synchronize":
+                continue
+            yield Finding(
+                "HVD016", ctx.relpath, node.lineno, node.col_offset,
+                "full-tree barrier in the backward→apply window: a "
+                "comprehension that synchronize()s every handle at "
+                "once serializes the entire gradient tree behind the "
+                "slowest collective — the exact pattern the overlap "
+                "plane (HOROVOD_OVERLAP_EAGER, docs/tensor-fusion.md) "
+                "replaces with readiness-ordered bucket dispatch "
+                "inside the backward window. Enqueue in reverse layer "
+                "order with coordinator.flush_ready() between "
+                "enqueues, and synchronize per bucket as results are "
+                "consumed; keep a whole-tree drain only with a "
+                "disable/baseline reason naming why every result must "
+                "materialize here.")
+        # idiom 2: jax.block_until_ready(grads) / grads
+        # .block_until_ready() on a gradient tree — a device-wide
+        # barrier between backward and apply
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            callee = (chain[-1] if chain else
+                      node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if callee != "block_until_ready":
+                continue
+            if _inside_instrument_step(node):
+                continue  # the sanctioned measurement sync
+            yield Finding(
+                "HVD016", ctx.relpath, node.lineno, node.col_offset,
+                "block_until_ready in the backward→apply window: a "
+                "host-side device barrier here drains the dispatch "
+                "pipeline and exposes every millisecond of comm the "
+                "overlap plane could have hidden under backward "
+                "compute. The step's one sanctioned sync lives in "
+                "trainer.instrument_step (it IS the measurement "
+                "boundary); anywhere else, let results stay futures "
+                "until the optimizer apply consumes them, or carry a "
+                "disable/baseline reason naming what must be "
+                "materialized and why.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1623,5 +1692,45 @@ Fix: take weights from the replica's WeightSubscriber
 swaps); keep a direct load only with a disable reason naming why the
 verify-then-arm protocol cannot apply.""",
             check_adhoc_weight_load),
+        Rule(
+            "HVD016", "full-tree-barrier-in-hot-path",
+            "whole-gradient-tree synchronize/block_until_ready between "
+            "backward and optimizer apply",
+            """HVD016 — full-tree barrier in the backward→apply window
+
+The overlap plane (PR 14, docs/tensor-fusion.md) dispatches fused
+gradient buckets in reverse-layer readiness order while backward is
+still producing later leaves, so collective time hides under compute
+— the framework's core perf story (overlap_frac / exposed_comm_ms in
+the attribution gauges, gated by the HVD_BENCH_OVERLAP leg). One line
+can undo all of it: a whole-tree barrier between backward and the
+optimizer apply forces every bucket to finish before anything is
+consumed, re-serializing comm behind compute exactly as if the plane
+did not exist — with no functional symptom, only a slower step.
+
+Two idioms are flagged in horovod_tpu/trainer.py, horovod_tpu/optim.py
+and ``# hvdlint: role=hot_path`` modules:
+
+  * ``[synchronize(h) for h in handles]`` — a comprehension draining
+    every outstanding handle at once (the barrier the reference's
+    per-tensor hooks exist to avoid, torch/__init__.py:95-130);
+  * ``jax.block_until_ready(tree)`` / ``.block_until_ready()`` — a
+    host-side device barrier (except lexically inside
+    ``trainer.instrument_step``, the sanctioned measurement sync).
+
+The historical shape: a debugging "wait for the grads" that ships, or
+a barrier-path fallback that quietly becomes the only path.
+
+Sanctioned sites ride the baseline with reasons: optim.py's barrier
+fallback (the reference behavior when HOROVOD_OVERLAP_EAGER is off),
+the overlap path's own final drain (dispatch already overlapped;
+results must materialize before apply returns), and
+broadcast_parameters' init-time drain (one-shot, not the step loop).
+
+Fix: enqueue in reverse layer order with
+``coordinator.flush_ready()`` between enqueues and synchronize per
+bucket as consumed; for device sync, rely on instrument_step's
+boundary or carry a disable reason naming what must materialize.""",
+            check_full_tree_barrier),
     ]
 }
