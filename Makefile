@@ -9,7 +9,7 @@ CPU_MESH = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 # distributed-correctness static analysis (tools/hvdlint, docs/hvdlint.md);
 # cheapest gate, so it leads the ci chain
 lint:
-	$(PY) -m tools.hvdlint horovod_tpu tools bench.py
+	$(PY) -m tools.hvdlint horovod_tpu tools bench.py examples
 	$(PY) -m tools.hvdlint --check-envdoc
 
 native:
@@ -35,6 +35,8 @@ examples:
 	    --dp 2 --tp 2 --sp 2 --attention ring
 	$(CPU_MESH) $(PY) examples/serve_lm.py --requests 12 --slots 2 \
 	    --max-len 64 --baseline
+	$(CPU_MESH) $(PY) examples/route_lm.py --requests 12 --replicas 2 \
+	    --slots 2 --max-len 64 --compare
 	$(CPU_MESH) $(PY) examples/synthetic_benchmark.py --model resnet18 \
 	    --batch-size 1 --image-size 32 --num-warmup-batches 1 \
 	    --num-iters 1 --num-batches-per-iter 2

@@ -1165,6 +1165,152 @@ def _bench_swap(on_tpu):
     return out
 
 
+def _bench_route(on_tpu):
+    """Router-plane A/B gate (docs/routing.md): the SAME bimodal
+    workload three ways — one bare engine, and two engines behind a
+    Router under each dispatch policy. Enforced (AssertionError):
+
+      * aggregate decode tokens per router step with 2 replicas must be
+        >=1.8x the single-replica tokens/step (the fan-out number: a
+        router step drives every live engine one scheduler iteration,
+        so near-2x is the contract and anything under 1.8x means the
+        front door serialized the replicas);
+      * least-loaded p99 TTFT must not exceed round-robin's under
+        deliberately adversarial imbalance — the workload alternates
+        40-token and 8-token requests, so round-robin's arrival parity
+        concentrates every long request on one replica while
+        least-loaded spreads them by live queue depth. TTFT is
+        measured in scheduler steps (first-token step = completion
+        step minus the decode tokens after it, each active slot
+        decoding one token per step), because in this single-threaded
+        harness a router step runs every busy engine serially — wall
+        TTFT would bill the balanced arm for the idle arm's savings.
+        Wall p99 rides along as a reported number.
+
+    Requests all arrive at step 0 with distinct prompts (no
+    cache-affinity interference): every dispatch decision is then pure
+    snapshot math — least-loaded greedily packs by the snapshot's
+    ``work_tokens`` term (queued + remaining decode tokens), which is
+    what spreads the longs; round-robin's parity ignores it. Both
+    verdicts are schedule math rather than host-timing luck."""
+    import jax
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"))
+    from serve_lm import serving_config
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.router import Router
+    from horovod_tpu.serving import AdmissionQueue, ServeEngine
+    from horovod_tpu.serving.queue import Request
+
+    cfg = serving_config(on_tpu)
+    _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    slots, max_len, kv_block = 2, 64, 8
+    n_requests = 32 if on_tpu else 24
+
+    def bimodal_workload(n, tag):
+        """[(arrival_step, Request)]: long/short alternating, all at
+        step 0 — round-robin's parity sends every long to the same
+        replica."""
+        wl = []
+        for i in range(n):
+            n_new = 40 if i % 2 == 0 else 8
+            prompt = tuple((7 * i + j) % 250 + 1 for j in range(6))
+            wl.append((0, Request(f"route-{tag}-{i}", prompt,
+                                  max_new_tokens=n_new,
+                                  temperature=0.0)))
+        return wl
+
+    def build_engine():
+        queue = AdmissionQueue(max_depth=n_requests + 8,
+                               admission_timeout_s=1e9)
+        return ServeEngine(cfg, params, num_slots=slots,
+                           max_len=max_len, kv_block=kv_block,
+                           queue=queue, seed=0)
+
+    def drain(submit, step, pending, workload, max_steps=100000):
+        """Returns (results-with-finish-step, total steps): each
+        element is (RequestResult, step index it surfaced at)."""
+        results, i, steps = [], 0, 0
+        while i < len(workload) or pending():
+            while i < len(workload) and workload[i][0] <= steps:
+                req = workload[i][1]
+                assert submit(req), \
+                    f"admission rejected {req.request_id}"
+                i += 1
+            results.extend((r, steps) for r in step())
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"route bench never drained ({len(results)} done)")
+        return results, steps
+
+    def _p99(values):
+        v = sorted(values)
+        return v[min(len(v) - 1, int(0.99 * len(v)))] if v else 0.0
+
+    def summarize(results, steps, arrivals):
+        done = [(r, s) for r, s in results if r.outcome == "completed"]
+        tokens = sum(len(r.tokens) for r, _ in done)
+        # first-token step: each active slot decodes one token per
+        # step, so completion step minus the tokens decoded after the
+        # first is exact — and deterministic, unlike wall TTFT
+        ttft_steps = [(s - (len(r.tokens) - 1)) - arrivals[r.request_id]
+                      for r, s in done]
+        ttft_wall = [r.ttft_s for r, _ in done if r.ttft_s is not None]
+        return {"completed": len(done),
+                "tokens_per_step": round(tokens / max(steps, 1), 3),
+                "p99_ttft_steps": _p99(ttft_steps),
+                "p99_ttft_ms": round(_p99(ttft_wall) * 1e3, 3),
+                "steps": steps}
+
+    def run_single(workload):
+        eng = build_engine()
+        return drain(eng.submit,
+                     eng.step,
+                     lambda: eng.active_count or len(eng.queue),
+                     workload)
+
+    def run_router(workload, policy):
+        router = Router({0: build_engine(), 1: build_engine()},
+                        policy=policy)
+        return drain(router.submit, router.step, router.pending,
+                     workload)
+
+    # untimed warmup compiles every prefill pad variant + decode step
+    run_single(bimodal_workload(4, "warm"))
+
+    def arm(runner, tag, *args):
+        wl = bimodal_workload(n_requests, tag)
+        arrivals = {req.request_id: t for t, req in wl}
+        return summarize(*runner(wl, *args), arrivals)
+
+    single = arm(lambda wl: run_single(wl), "s")
+    ll = arm(run_router, "ll", "least_loaded")
+    rr = arm(run_router, "rr", "round_robin")
+
+    agg_speedup = ll["tokens_per_step"] / max(single["tokens_per_step"],
+                                              1e-9)
+    out = {
+        "requests": n_requests,
+        "replicas": 2,
+        "single": single,
+        "least_loaded": ll,
+        "round_robin": rr,
+        "agg_speedup_tokens_per_step": round(agg_speedup, 3),
+    }
+    assert single["completed"] == ll["completed"] == rr["completed"] \
+        == n_requests, f"arms completed different request sets: {out}"
+    assert agg_speedup >= 1.8, (
+        f"2 replicas behind the router deliver {agg_speedup:.2f}x "
+        f"aggregate tokens/step, under the 1.8x budget: {out}")
+    assert ll["p99_ttft_steps"] <= rr["p99_ttft_steps"], (
+        f"least-loaded p99 TTFT {ll['p99_ttft_steps']} steps exceeds "
+        f"round-robin's {rr['p99_ttft_steps']} under bimodal "
+        f"imbalance: {out}")
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -1460,6 +1606,14 @@ def main():
     swap = None
     if os.environ.get("HVD_BENCH_SWAP", "") != "0":
         swap = _bench_swap(on_tpu)
+    # Router-plane fan-out gate: 2 replicas behind one Router must
+    # deliver >=1.8x aggregate decode tokens/step vs one replica, and
+    # least-loaded dispatch must hold p99 TTFT at-or-under round-robin
+    # under bimodal imbalance; ENFORCED (AssertionError).
+    # HVD_BENCH_ROUTE=0 skips it.
+    route = None
+    if os.environ.get("HVD_BENCH_ROUTE", "") != "0":
+        route = _bench_route(on_tpu)
     # Checkpoint-plane overhead gate: async double-buffered saves every
     # step vs no checkpointing around a calibrated training-shaped
     # step; the <=2% budget is ENFORCED (AssertionError), the
@@ -1643,6 +1797,7 @@ def main():
         "overlap": overlap,
         "serve": serve,
         "swap": swap,
+        "route": route,
         "ckpt": ckpt,
         "perf_attrib": perf_attrib,
         "metrics": metrics_snap,

@@ -13,7 +13,7 @@ echo "--- hvdlint (fastest gate: distributed-correctness static analysis)"
 # exceptions, jit impurity and leaked tracing spans statically
 # (docs/hvdlint.md); then verifies
 # docs/envvars.md still matches ENV_REGISTRY.
-python -m tools.hvdlint horovod_tpu tools bench.py
+python -m tools.hvdlint horovod_tpu tools bench.py examples
 python -m tools.hvdlint --check-envdoc
 
 echo "--- build native core"
@@ -113,6 +113,15 @@ echo "--- fleet plane (fast fail: publication pointer, hot-swap parity, refusal)
 # traffic) rides test_chaos_plane.py with the other drills.
 python -m pytest tests/test_fleet.py -q -m "not slow"
 python tools/hvd_fleet.py --selftest
+
+echo "--- router plane (fast fail: dispatch scoring, affinity, reroute ledger, canary verdicts)"
+# The router plane (docs/routing.md) is the serving front door: one
+# admission point scoring heartbeat-carried load snapshots across N
+# replicas, exactly-once reroute on replica loss, and the SLO-gated
+# canary state machine. The suite is process-local math on synthetic
+# snapshots/histograms plus tiny-model dispatch runs; the 2-process
+# replica-loss and poisoned-canary drills ride test_chaos_plane.py.
+python -m pytest tests/test_router.py -q -m "not slow"
 
 echo "--- perf attribution (fast fail: overlap math, roofline model, regression ledger)"
 # The perf-attribution plane (docs/profiling.md) is how every other

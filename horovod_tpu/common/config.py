@@ -351,6 +351,41 @@ ENV_REGISTRY = (
      "(0 disables)."),
     ("HOROVOD_RING_ALLREDUCE", True, "0", "common/config.py",
      "Use the explicit ppermute ring allreduce backend."),
+    ("HOROVOD_ROUTE_AFFINITY_PREFIX", True, "8", "router/core.py",
+     "Router plane: prompt-prefix length (tokens) hashed for cache-"
+     "affinity stickiness; 0 disables affinity routing."),
+    ("HOROVOD_ROUTE_CANARY_GOODPUT_DROP", True, "0.10",
+     "router/canary.py",
+     "Canary rollout: roll back when the canary cohort's goodput "
+     "ratio (completed tokens / all tokens) falls more than this "
+     "below the baseline cohort's."),
+    ("HOROVOD_ROUTE_CANARY_MIN_DELTA_S", True, "0.025",
+     "router/canary.py",
+     "Canary rollout: a latency breach additionally needs this "
+     "absolute p99 gap (seconds) — keeps the verdict above the "
+     "histogram buckets' own resolution."),
+    ("HOROVOD_ROUTE_CANARY_PCT", True, "10.0", "router/canary.py",
+     "Canary rollout: percent of traffic (deterministic request-id "
+     "hash) steered to the cohort serving the newly armed weight "
+     "generation."),
+    ("HOROVOD_ROUTE_CANARY_REPLICAS", True, "1", "router/canary.py",
+     "Canary rollout: max replicas admitted to the canary cohort when "
+     "several arm the new generation at once; the rest hold as "
+     "baseline."),
+    ("HOROVOD_ROUTE_CANARY_TTFT_X", True, "1.5", "router/canary.py",
+     "Canary rollout: roll back when the canary cohort's p99 TTFT or "
+     "inter-token gap exceeds this multiple of the baseline "
+     "cohort's."),
+    ("HOROVOD_ROUTE_CANARY_WINDOW", True, "24", "router/canary.py",
+     "Canary rollout: completed requests each cohort must accumulate "
+     "before the promote/rollback verdict is computed."),
+    ("HOROVOD_ROUTE_POLICY", True, "least_loaded", "router/policy.py",
+     "Router plane: dispatch policy over live replica load snapshots "
+     "(least_loaded, round_robin)."),
+    ("HOROVOD_ROUTE_REROUTE_WINDOW_S", True, "30.0", "router/core.py",
+     "Router plane: max age (seconds since dispatch) a request may be "
+     "requeued to a survivor after its replica is lost; older "
+     "requests fail loudly instead of resurrecting."),
     ("HOROVOD_SERVE_ADMISSION_TIMEOUT_S", True, "10.0",
      "serving/queue.py",
      "Serving admission control: reject a queued request after waiting "
@@ -480,6 +515,10 @@ ENV_REGISTRY = (
     ("HVD_BENCH_QUANT", False, None, "bench.py",
      "Set 0 to skip the quantized-wire bench leg (int8 vs bf16 wire "
      "bytes + none-codec overhead gate)."),
+    ("HVD_BENCH_ROUTE", False, None, "bench.py",
+     "Set 0 to skip the router bench leg (2 replicas behind one "
+     "Router: aggregate decode tokens/step >=1.8x one replica; "
+     "least-loaded p99 TTFT <= round-robin under bimodal load)."),
     ("HVD_BENCH_SERVE", False, None, "bench.py",
      "Set 0 to skip the serving bench leg (continuous vs static "
      "batching under Poisson load, p50/p99 TTFT)."),

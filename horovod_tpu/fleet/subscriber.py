@@ -110,6 +110,16 @@ class WeightSubscriber:
             return self._current_gen
 
     @property
+    def armed_generation(self):
+        """The standby generation loaded + verified but not yet swapped
+        in (None when nothing is armed). The router's canary controller
+        reads this — via the heartbeat load piggyback — to find the
+        canary cohort before any engine swaps (docs/routing.md)."""
+        with self._lock:
+            return (self._armed.generation if self._armed is not None
+                    else None)
+
+    @property
     def refusals(self):
         """{generation: reason} for every publish this replica refused."""
         with self._lock:

@@ -329,7 +329,7 @@ def decode_response(payload):
 class CycleRequest:
     def __init__(self, rank, entries, ack, shutdown=False, req_id=0,
                  hits=b"", metrics=None, flight=None, digest=None,
-                 codec_fp=None):
+                 codec_fp=None, load=None):
         self.rank = rank
         self.entries = entries  # list[EntryMeta]
         self.ack = ack          # last response seq this worker applied
@@ -358,6 +358,13 @@ class CycleRequest:
         # extra connections, no extra message types. None on the other
         # ~99% of cycles.
         self.metrics = metrics
+        # serving-load piggyback (serving/replica.py): a serving
+        # replica's heartbeat attaches its compact load snapshot (queue
+        # depth, active slots, free KV blocks, generations) so the
+        # router reads live per-replica state off the coordinator's
+        # ledger instead of polling replicas. Plain-pickled, wire-safe —
+        # same pattern as `metrics`.
+        self.load = load
         # idempotency token: a retry after a lost response reuses the id,
         # and the coordinator skips re-submitting entries it already
         # recorded (a popped-and-resubmitted name would otherwise create
@@ -516,6 +523,10 @@ class CoordinatorService(network.BasicService):
         # plus the coordinator-side instruments (bound once here — the
         # per-cycle cost in _handle is an inc/observe, not a lookup)
         self.metrics_snapshots = {}
+        # router plane (horovod_tpu/router/): per-replica serving-load
+        # snapshots piggybacked on heartbeats (rank -> dict); the router
+        # scores dispatch over this ledger, never an extra RPC
+        self.load_snapshots = {}
         # tracing plane: stall/liveness escalation flips _dump_requested,
         # every subsequent CycleResponse carries the flag, and each
         # worker's next cycle piggybacks its flight snapshot — persisted
@@ -605,6 +616,8 @@ class CoordinatorService(network.BasicService):
                 self._m_cycles.inc()
                 if req.metrics is not None:
                     self.metrics_snapshots[req.rank] = req.metrics
+                if getattr(req, "load", None) is not None:
+                    self.load_snapshots[req.rank] = req.load
                 if req.flight is not None:
                     path = hvd_tracing.write_remote_dump(
                         req.flight, rank=req.rank)
@@ -1179,12 +1192,13 @@ class NegotiationWorker:
                 time.sleep(0.2)
 
     def cycle(self, entries, ack, shutdown=False, req_id=0, hits=b"",
-              metrics=None, flight=None, digest=None, codec_fp=None):
+              metrics=None, flight=None, digest=None, codec_fp=None,
+              load=None):
         return self._client.request(
             CycleRequest(self._rank, entries, ack, shutdown,
                          req_id=req_id, hits=hits, metrics=metrics,
                          flight=flight, digest=digest,
-                         codec_fp=codec_fp))
+                         codec_fp=codec_fp, load=load))
 
     def close(self, linger_s=2.0):
         """Stop the coordinator service — after a grace window, so peers

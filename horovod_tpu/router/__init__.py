@@ -1,0 +1,19 @@
+"""Router plane: N serve replicas behind one admission point.
+
+``Router`` (core.py) dispatches requests over live heartbeat-carried
+load snapshots with cache-affinity stickiness, reroutes on replica
+loss, and — through ``CanaryController`` (canary.py) — rolls weight
+generations out by traffic fraction, gated on live SLO histograms.
+Policies live in policy.py; the full story is docs/routing.md.
+"""
+
+from .canary import CanaryController
+from .core import ReplicaHandle, Router
+from .policy import (AFFINITY_SLACK, POLICIES, LeastLoaded, RoundRobin,
+                     prefix_key, resolve, score)
+
+__all__ = [
+    "Router", "ReplicaHandle", "CanaryController", "resolve", "score",
+    "prefix_key", "RoundRobin", "LeastLoaded", "POLICIES",
+    "AFFINITY_SLACK",
+]

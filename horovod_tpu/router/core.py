@@ -1,0 +1,315 @@
+"""The router: N serve replicas behind ONE admission point
+(docs/routing.md).
+
+This is the serving half of the paper's coordinator bet: one small
+control point sequencing named work across ranks — applied to requests
+instead of tensors. Clients talk to the ``Router``; the router scores
+every live replica's heartbeat-piggybacked load snapshot (queue depth,
+active slots, free KV blocks, generations — serving/replica.py) and
+dispatches to the winner, with cache-affinity stickiness layered on
+top (policy.py). Each ``step()`` drives every live engine one
+scheduler iteration and hands back their results stamped with the
+replica that served them.
+
+Replica loss is the router's second job: when the control plane
+declares a replica dead (``RanksLostError`` via each engine's
+``on_ranks_lost`` callback, wired to ``on_ranks_lost()`` here), the
+router requeues that replica's unfinished requests to survivors —
+fresh Request objects, fresh traces, results stamped ``rerouted`` —
+bounded by ``HVD_ROUTE_REROUTE_WINDOW_S`` so an hours-stale request
+fails loudly instead of resurrecting. Exactly-once by construction:
+the assignment ledger entry is popped before the re-dispatch, so a
+second loss event (or a survivor's later loss) can never duplicate
+work, only re-reroute what is still unfinished.
+
+The optional ``canary`` (canary.py) restricts dispatch candidates per
+the rollout state before the policy sees them; everything else —
+scoring, affinity, reroute — is identical on both cohorts, which is
+what makes the SLO comparison an apples-to-apples A/B.
+
+hvdlint HVD017 enforces the one-front-door contract: examples/ and
+tools/ submit through a Router (or carry a baselined reason), never a
+bare ``ServeEngine.submit``.
+"""
+
+import time
+
+from ..common import config
+from ..serving import tracing as serve_tracing
+from ..serving.queue import Request, RequestResult
+from ..utils import metrics as hvd_metrics
+from . import policy as route_policy
+
+
+class _Assigned:
+    """Ledger row: where one admitted request currently lives."""
+
+    __slots__ = ("replica", "request", "assigned_ts", "rerouted",
+                 "attempts")
+
+    def __init__(self, replica, request, assigned_ts, rerouted=False,
+                 attempts=0):
+        self.replica = replica
+        self.request = request
+        self.assigned_ts = assigned_ts
+        self.rerouted = rerouted
+        self.attempts = attempts
+
+
+class ReplicaHandle:
+    """One fronted engine. ``replica_id`` doubles as the control-plane
+    rank when the engine rides a ReplicaGroup: the heartbeat load
+    ledger and RanksLostError rank lists are both keyed by it."""
+
+    __slots__ = ("replica_id", "engine", "live")
+
+    def __init__(self, replica_id, engine):
+        self.replica_id = int(replica_id)
+        self.engine = engine
+        self.live = True
+
+
+class Router:
+    """Dispatch + liveness + reroute over a set of ServeEngines.
+
+    ``replicas`` is {replica_id: engine} (or an iterable of
+    ReplicaHandle). ``policy`` is a policy object, a name, or None for
+    ``HVD_ROUTE_POLICY``. ``group`` (the rank-0 ReplicaGroup, optional)
+    adds the coordinator's heartbeat load ledger to ``loads()`` so
+    heartbeat-only peers show up too. ``canary`` is a
+    CanaryController; construct the engines with ``swap_gate=
+    canary.gate(replica_id)`` so the controller also holds baseline
+    replicas on the old weights while the canary cohort runs ahead.
+    """
+
+    def __init__(self, replicas, policy=None, canary=None, group=None,
+                 affinity_prefix=None, reroute_window_s=None,
+                 clock=time.monotonic):
+        self._handles = {}
+        for item in (replicas.items() if hasattr(replicas, "items")
+                     else replicas):
+            handle = (item if isinstance(item, ReplicaHandle)
+                      else ReplicaHandle(*item))
+            self._handles[handle.replica_id] = handle
+        if not self._handles:
+            raise ValueError("Router needs at least one replica")
+        self._policy = (policy if hasattr(policy, "choose")
+                        else route_policy.resolve(policy))
+        self.canary = canary
+        self._group = group
+        self._affinity_k = (
+            config.env_int("ROUTE_AFFINITY_PREFIX", 8)
+            if affinity_prefix is None else int(affinity_prefix))
+        self._reroute_window_s = (
+            config.env_float("ROUTE_REROUTE_WINDOW_S", 30.0)
+            if reroute_window_s is None else float(reroute_window_s))
+        self._clock = clock
+        self._sticky = {}    # affinity prefix key -> replica_id
+        self._inflight = {}  # request_id -> _Assigned
+        self._pending_results = []  # loss-path failures, drained by step
+        reg = self._metrics = hvd_metrics.get_registry()
+        self._m_requests = reg.counter(
+            "hvd_route_requests_total",
+            "Requests the router dispatched, by destination replica.",
+            labels=("replica",))
+        self._m_rerouted = reg.counter(
+            "hvd_route_rerouted_total",
+            "Requests re-dispatched to a survivor after their replica "
+            "was declared lost.")
+        self._m_affinity = reg.counter(
+            "hvd_route_affinity_total",
+            "Cache-affinity stickiness outcomes per dispatch: hit "
+            "(sticky replica won), miss (first sighting of the "
+            "prefix), overflow (sticky replica too loaded — policy "
+            "pick won).", labels=("outcome",))
+        self._m_live = reg.gauge(
+            "hvd_route_replicas_live",
+            "Replicas the router currently dispatches to.")
+        self._m_live.set(len(self.live_replicas()))
+
+    # -- live state ----------------------------------------------------
+
+    def live_replicas(self):
+        return sorted(r for r, h in self._handles.items() if h.live)
+
+    def loads(self):
+        """Per-replica load snapshots: the coordinator's heartbeat
+        ledger (covers heartbeat-only peers) overlaid with each local
+        engine's own snapshot (always current for fronted engines)."""
+        out = {}
+        if self._group is not None:
+            out.update(self._group.peer_loads())
+        for rid, h in self._handles.items():
+            if h.live:
+                out[rid] = h.engine.load_snapshot()
+        return out
+
+    @property
+    def inflight(self):
+        """request_id -> replica_id of every dispatched, unfinished
+        request (the reroute ledger, exposed for drills/tests)."""
+        return {rid: a.replica for rid, a in self._inflight.items()}
+
+    # -- dispatch ------------------------------------------------------
+
+    def submit(self, request):
+        """Route one request to a live replica; returns whether it was
+        admitted (False = the chosen replica's queue rejected it, which
+        that queue already counted and evented)."""
+        loads = self.loads()
+        candidates = self.live_replicas()
+        if self.canary is not None:
+            candidates = self.canary.filter(request.request_id,
+                                            candidates, loads)
+        if not candidates:
+            self._metrics.event("route_no_replica",
+                                request_id=request.request_id)
+            return False
+        pick, how = self._choose(request, candidates, loads)
+        return self._dispatch(pick, request, how=how)
+
+    def _choose(self, request, candidates, loads):
+        """Affinity-over-policy: the sticky replica wins while its cost
+        is within AFFINITY_SLACK of the policy's pick; otherwise the
+        policy pick wins and the prefix re-pins to it."""
+        pick = self._policy.choose(candidates, loads)
+        key = route_policy.prefix_key(request.prompt, self._affinity_k)
+        if key is None:
+            return pick, "policy"
+        sticky = self._sticky.get(key)
+        if sticky is not None and sticky in candidates:
+            gap = (route_policy.score(loads.get(sticky)) -
+                   route_policy.score(loads.get(pick)))
+            if gap <= route_policy.AFFINITY_SLACK:
+                self._m_affinity.labels(outcome="hit").inc()
+                return sticky, "affinity"
+            self._m_affinity.labels(outcome="overflow").inc()
+        else:
+            self._m_affinity.labels(outcome="miss").inc()
+        self._sticky[key] = pick
+        return pick, "policy"
+
+    def _dispatch(self, rid, request, how, rerouted=False, attempts=0):
+        if not self._handles[rid].engine.submit(request):
+            return False
+        self._inflight[request.request_id] = _Assigned(
+            rid, request, self._clock(), rerouted=rerouted,
+            attempts=attempts)
+        self._m_requests.labels(replica=str(rid)).inc()
+        trace = serve_tracing.trace_of(request)
+        trace.annotate(replica=rid, rerouted=rerouted)
+        serve_tracing.route_span(
+            tensor=request.request_id, trace_id=trace.trace_id,
+            parent=getattr(trace, "root", None), replica=rid,
+            policy=self._policy.name, how=how,
+            rerouted=rerouted).close()
+        return True
+
+    # -- the step loop -------------------------------------------------
+
+    def step(self):
+        """One scheduler iteration on every live engine. Returns the
+        RequestResults that finished, stamped with the replica that
+        served them and the rerouted flag. The canary ticks BEFORE the
+        engines step: a newly armed generation must be claimed by the
+        controller (cohort chosen, gates closed) before any engine's
+        same-step swap poll could take it — tick-after-step would let
+        the whole fleet self-swap through a still-idle gate."""
+        if self.canary is not None:
+            self.canary.tick(self.loads())
+        done, self._pending_results = self._pending_results, []
+        for rid in self.live_replicas():
+            handle = self._handles[rid]
+            if not handle.live:  # lost mid-loop by a peer's heartbeat
+                continue
+            for res in handle.engine.step():
+                done.append(self._stamp(rid, res))
+        return done
+
+    def run_to_completion(self, max_steps=100000):
+        out = []
+        for _ in range(max_steps):
+            out.extend(self.step())
+            if not self.pending():
+                break
+        return out
+
+    def pending(self):
+        if self._inflight or self._pending_results:
+            return True
+        return any(h.engine.active_count or len(h.engine.queue)
+                   for h in self._handles.values() if h.live)
+
+    def _stamp(self, rid, res):
+        asg = self._inflight.pop(res.request_id, None)
+        res.replica = rid
+        if asg is not None and asg.rerouted:
+            res.rerouted = True
+        if self.canary is not None:
+            self.canary.observe(res, rid)
+        return res
+
+    # -- replica loss + reroute ----------------------------------------
+
+    def on_ranks_lost(self, lost):
+        """Wire as every engine's ``on_ranks_lost`` callback. Marks the
+        dead replicas, then requeues each one's unfinished requests to
+        survivors (exactly-once: ledger rows are popped before
+        re-dispatch, so repeated loss notifications are idempotent)."""
+        now = self._clock()
+        for rid in sorted({int(r) for r in lost}):
+            handle = self._handles.get(rid)
+            if handle is not None:
+                handle.live = False
+            victims = [a for a in list(self._inflight.values())
+                       if a.replica == rid]
+            self._metrics.event(
+                "route_replica_lost", replica=rid,
+                inflight=sorted(a.request.request_id for a in victims))
+            for asg in victims:
+                self._inflight.pop(asg.request.request_id, None)
+                self._reroute(asg, now)
+        self._m_live.set(len(self.live_replicas()))
+
+    def _fail(self, asg, reason, now):
+        trace = serve_tracing.trace_of(asg.request)
+        phases = trace.on_retire("failed", reason)
+        self._pending_results.append(RequestResult(
+            asg.request.request_id, (), "failed", finish_ts=now,
+            reason=reason, trace_id=trace.trace_id,
+            phase_ms=phases or None, replica=asg.replica,
+            rerouted=asg.rerouted))
+
+    def _reroute(self, asg, now):
+        req = asg.request
+        waited = now - asg.assigned_ts
+        if waited > self._reroute_window_s:
+            self._fail(asg, "reroute_window", now)
+            return
+        survivors = self.live_replicas()
+        loads = self.loads()
+        if self.canary is not None:
+            survivors = self.canary.filter(req.request_id, survivors,
+                                           loads)
+        if not survivors:
+            self._fail(asg, "no_survivors", now)
+            return
+        # close the dead attempt's trace, then resubmit a FRESH Request
+        # (no trace attr) so the queue mints a new lifecycle — the old
+        # spans belong to the lost replica's story, not the retry's
+        serve_tracing.trace_of(req).on_retire("failed", "replica_lost")
+        retry = Request(
+            request_id=req.request_id, prompt=req.prompt,
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature, deadline_s=req.deadline_s,
+            arrival_ts=req.arrival_ts)
+        pick, how = self._choose(retry, survivors, loads)
+        if not self._dispatch(pick, retry, how=how, rerouted=True,
+                              attempts=asg.attempts + 1):
+            self._fail(asg, "reroute_rejected", now)
+            return
+        self._m_rerouted.inc()
+        self._metrics.event(
+            "route_reroute", request_id=req.request_id,
+            from_replica=asg.replica, to_replica=pick,
+            attempt=asg.attempts + 1, waited_s=round(waited, 6))

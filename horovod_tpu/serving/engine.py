@@ -99,7 +99,8 @@ class ServeEngine:
     def __init__(self, cfg, params, num_slots=None, max_len=None,
                  kv_block=None, total_blocks=None, policy="continuous",
                  queue=None, seed=0, replica=None, on_ranks_lost=None,
-                 subscriber=None, generation=None, clock=time.monotonic):
+                 subscriber=None, generation=None, clock=time.monotonic,
+                 swap_gate=None):
         self.cfg = cfg
         self.params = params
         # fleet plane (docs/fleet.md): the subscriber feeds armed weight
@@ -129,6 +130,12 @@ class ServeEngine:
         self._step_count = 0
         self._replica = replica
         self._on_ranks_lost = on_ranks_lost
+        # router/canary hook (horovod_tpu/router/canary.py): called with
+        # the armed generation before a swap; returning False holds this
+        # replica on its current weights (the generation stays armed and
+        # is re-offered next step). None = swap whenever armed, the
+        # pre-router behavior.
+        self._swap_gate = swap_gate
         self._active = {}  # slot -> _Active
         self._finished = []
         reg = self._metrics = hvd_metrics.get_registry()
@@ -234,6 +241,32 @@ class ServeEngine:
         """The weight generation newly admitted requests decode on."""
         return self._generation
 
+    def load_snapshot(self):
+        """Compact live-load summary — what the router scores dispatch
+        on (docs/routing.md). Rides every heartbeat as the ``load``
+        piggyback, so keep it a few plain ints: queue depth, busy/free
+        slots, outstanding decode work in tokens (queued + remaining
+        on active slots — the term that makes least-loaded cost-aware
+        under bimodal lengths), free KV blocks, and the current +
+        armed weight generations (the canary controller reads cohorts
+        off these)."""
+        ledger = self.kv.ledger
+        sub = self._subscriber
+        work = sum(max(st.request.max_new_tokens - len(st.generated), 0)
+                   for st in self._active.values())
+        if hasattr(self.queue, "queued_work_tokens"):
+            work += self.queue.queued_work_tokens()
+        return {
+            "queue_depth": len(self.queue),
+            "active_slots": len(self._active),
+            "work_tokens": work,
+            "free_slots": self.kv.num_slots - len(self._active),
+            "free_blocks": ledger.total_blocks - ledger.blocks_in_use,
+            "generation": self._generation,
+            "armed_generation": (getattr(sub, "armed_generation", None)
+                                 if sub is not None else None),
+        }
+
     # -- internals ------------------------------------------------------
 
     def _maybe_swap(self):
@@ -247,6 +280,10 @@ class ServeEngine:
         if sub is None:
             return
         sub.poll()
+        if self._swap_gate is not None:
+            armed = getattr(sub, "armed_generation", None)
+            if armed is not None and not self._swap_gate(armed):
+                return  # held by the canary gate; re-offered next step
         rec = sub.take_armed()
         if rec is None:
             return
@@ -293,7 +330,7 @@ class ServeEngine:
         if self._replica is None:
             return
         try:
-            self._replica.heartbeat()
+            self._replica.heartbeat(load=self.load_snapshot())
         except RanksLostError as err:
             lost = tuple(int(r) for r in err.ranks)
             # name the in-flight requests in the event: their spans are

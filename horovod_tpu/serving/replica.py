@@ -62,18 +62,35 @@ class ReplicaGroup:
         poke its liveness ledger directly."""
         return self._worker.service
 
-    def heartbeat(self):
+    def heartbeat(self, load=None):
         """One liveness cycle. Raises RanksLostError (naming the dead
         ranks) once the coordinator's ledger declares peers lost; any
         transport error surfaces to the caller too — silence is the one
         thing this method must never produce. The span makes a slow
         control plane visible in the request-path story (a RanksLost
-        heartbeat aborts the span, which the failover dump keeps)."""
+        heartbeat aborts the span, which the failover dump keeps).
+
+        ``load`` (a compact dict: queue depth, active slots, free KV
+        blocks, generations — ServeEngine.load_snapshot) piggybacks on
+        the cycle so the coordinator's ledger always holds fresh
+        per-replica serving state for the router to score against; no
+        extra RPC, no polling (docs/routing.md)."""
         with serve_tracing.heartbeat_span(replica=self.rank):
-            resp = self._worker.cycle([], -1, req_id=self._req_id)
+            resp = self._worker.cycle([], -1, req_id=self._req_id,
+                                      load=load)
             self._req_id += 1
             neg.raise_if_ranks_lost(resp)
         return resp
+
+    def peer_loads(self):
+        """The coordinator's per-replica load ledger ({rank: snapshot}),
+        available on rank 0 (where the router runs); {} elsewhere or
+        before any replica has heartbeated a snapshot."""
+        service = self._worker.service
+        if service is None:
+            return {}
+        with service._lock:
+            return dict(service.load_snapshots)
 
     def close(self, linger_s=0.5):
         self._worker.close(linger_s=linger_s)

@@ -287,6 +287,16 @@ def heartbeat_span(**attrs):
     return hvd_tracing.get_tracer().span(hvd_tracing.HEARTBEAT, **attrs)
 
 
+def route_span(**attrs):
+    """One span per router dispatch decision (horovod_tpu/router/):
+    which replica won, under which policy/affinity path, and whether
+    this was a reroute after a replica loss — closed immediately, so
+    the request's trace tree records where it was sent and why."""
+    if not enabled():
+        return hvd_tracing._NULL_SPAN
+    return hvd_tracing.get_tracer().span(hvd_tracing.ROUTE, **attrs)
+
+
 def tick_span(**attrs):
     """One span per fused decode step (the engine-wide lane)."""
     if not enabled():

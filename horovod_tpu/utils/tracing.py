@@ -68,8 +68,12 @@ PREFILL = "prefill"
 DECODE = "decode"
 DECODE_TICK = "decode_tick"
 HEARTBEAT = "heartbeat"
+# router plane (horovod_tpu/router/): one ROUTE span per dispatch
+# decision — which replica won, under which policy, and whether the
+# request was a reroute after a replica loss (docs/routing.md).
+ROUTE = "route"
 SERVE_STAGES = (REQUEST, QUEUE_WAIT, PREFILL, DECODE, DECODE_TICK,
-                HEARTBEAT)
+                HEARTBEAT, ROUTE)
 STAGES = (ENQUEUE, NEGOTIATE, FUSION, EXECUTE, CALLBACK, STEP,
           CYCLE) + SERVE_STAGES
 

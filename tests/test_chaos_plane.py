@@ -1400,3 +1400,359 @@ class TestDrillFleetHotSwap:
             verdict["reasons"]
         assert any("swapped to" in r for r in verdict["reasons"]), \
             verdict["reasons"]
+
+
+# ---------------------------------------------------------------------------
+# router-plane drills: the front door under replica loss (2-process,
+# real control plane) and a poisoned canary generation (real fleet
+# publish -> subscribe -> gate path, per-replica virtual clocks).
+# ---------------------------------------------------------------------------
+
+class TestDrillRouterReplicaLost:
+    def test_reroute_is_exactly_once_and_postmortem_tells_it(
+            self, tmp_path):
+        """Drill (j), the router plane: 2 replica processes on the
+        negotiation control plane. Rank 0 hosts the front door — a
+        Router fronting two real engines, one riding the ReplicaGroup
+        as rank 0 and one standing in (locally) for the remote
+        replica's serving capacity under replica id 1. Rank 1 wedges
+        mid-stream. The coordinator's ledger must turn that silence
+        into RanksLostError on replica 0's heartbeat; the engine's
+        failover hands the lost ranks to the router, which must requeue
+        replica 1's in-flight requests to the survivor EXACTLY once —
+        every request completes, the rerouted ones stamped — and the
+        postmortem must name both the lost rank and each reroute from
+        the dumps alone."""
+
+        def fn():
+            import os
+            import time
+            import jax
+            import jax.numpy as jnp
+            from horovod_tpu.models import transformer as tr
+            from horovod_tpu.router import Router
+            from horovod_tpu.serving.engine import ServeEngine
+            from horovod_tpu.serving.queue import AdmissionQueue, Request
+            from horovod_tpu.serving.replica import ReplicaGroup
+            from horovod_tpu.utils import tracing as hvd_tracing
+
+            r = int(os.environ["HVD_PROCESS_ID"])
+            port = int(os.environ["DRILL_PORT"])
+            done_file = os.environ["DRILL_DONE_FILE"]
+            hvd_tracing.reset(enabled=True, rank=r)
+            if r == 1:
+                group = ReplicaGroup(r, 2, ("127.0.0.1", port),
+                                     key=b"k" * 32,
+                                     rank_lost_timeout_s=1.5,
+                                     start_timeout_s=120.0)
+                for _ in range(3):
+                    group.heartbeat()
+                    time.sleep(0.05)
+                deadline = time.monotonic() + 120.0
+                while not os.path.exists(done_file) and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.1)
+                group.close(linger_s=0.0)
+                return (r, None, None, None, None)
+
+            # rank 0: warm the jit caches BEFORE joining the group
+            # (compiles inside would stall heartbeats past the window)
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            warm = ServeEngine(
+                cfg, params, num_slots=2, max_len=48, kv_block=8,
+                queue=AdmissionQueue(max_depth=8,
+                                     admission_timeout_s=1e9))
+            warm.submit(Request("warm", (3, 1, 4), max_new_tokens=4))
+            warm.run_to_completion()
+
+            group = ReplicaGroup(r, 2, ("127.0.0.1", port),
+                                 key=b"k" * 32, rank_lost_timeout_s=1.5,
+                                 start_timeout_s=120.0)
+            lost_box, router_box = [], []
+
+            def on_lost(lost):
+                lost_box.append(lost)
+                router_box[0].on_ranks_lost(lost)
+
+            def build(replica=None, cb=None):
+                return ServeEngine(
+                    cfg, params, num_slots=2, max_len=48, kv_block=8,
+                    queue=AdmissionQueue(max_depth=32,
+                                         admission_timeout_s=1e9),
+                    replica=replica, on_ranks_lost=cb)
+
+            router = Router({0: build(group, on_lost), 1: build()},
+                            policy="least_loaded", affinity_prefix=0,
+                            reroute_window_s=60.0)
+            router_box.append(router)
+            for i in range(4):
+                router.submit(Request(f"pre-{i}", (3, 1, 4),
+                                      max_new_tokens=24))
+            assigned = dict(router.inflight)
+            results = []
+            t0 = time.monotonic()
+            detect_s = None
+            while time.monotonic() - t0 < 60.0:
+                results.extend(router.step())
+                if lost_box:
+                    detect_s = time.monotonic() - t0
+                    break
+                # pace the decode so pre-* are still mid-stream when
+                # the loss lands — there must be work to reroute
+                time.sleep(0.15)
+            with open(done_file, "w") as f:
+                f.write("done")
+            # failover must not stop the music: post-loss requests
+            # route to the survivor and serve
+            for i in range(2):
+                router.submit(Request(f"post-{i}", (1, 2),
+                                      max_new_tokens=4))
+            results.extend(router.run_to_completion())
+            # the final dump supersedes the failover's and carries the
+            # full event ring: replica_lost, each reroute, completions
+            hvd_tracing.get_tracer().dump(reason="router_drill")
+            outcomes = sorted((x.request_id, x.outcome, x.replica,
+                               x.rerouted) for x in results)
+            return (r, detect_s, lost_box, assigned, outcomes)
+
+        env = dict(_ENV)
+        env["HVD_FLIGHT_DIR"] = str(tmp_path)
+        env["DRILL_PORT"] = str(network.free_port())
+        env["DRILL_DONE_FILE"] = str(tmp_path / "victim.done")
+        results = run(fn, num_proc=2, env=env, start_timeout_s=180.0)
+
+        by_rank = {x[0]: x for x in results}
+        _, detect_s, lost_box, assigned, outcomes = by_rank[0]
+        assert detect_s is not None, \
+            "replica 0 never detected the wedged peer"
+        assert detect_s < 30.0, f"detection took {detect_s:.1f}s"
+        assert lost_box == [(1,)], lost_box
+        victims = sorted(rid for rid, rep in assigned.items()
+                         if rep == 1)
+        assert len(victims) == 2, assigned  # the split was 2/2
+        # exactly-once: 6 submissions, 6 completions, no duplicates
+        assert len(outcomes) == 6 and \
+            len({rid for rid, _, _, _ in outcomes}) == 6, outcomes
+        assert all(o == "completed" for _, o, _, _ in outcomes)
+        # every result was served by the survivor or pre-loss replica 0,
+        # and exactly the victims carry the rerouted stamp
+        assert all(rep == 0 for _, _, rep, _ in outcomes), outcomes
+        assert sorted(rid for rid, _, _, rr in outcomes if rr) == \
+            victims, outcomes
+
+        # the postmortem names the lost rank and each reroute
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_postmortem
+        loaded, bad = hvd_postmortem.load_dumps(
+            hvd_postmortem.find_dumps(str(tmp_path)))
+        assert not bad
+        hvd_postmortem.rebase(loaded)
+        verdict = hvd_postmortem.analyze(loaded)
+        assert verdict["divergent_rank"] == 1, verdict
+        moves = {(e.get("request_id"), e.get("from_replica"),
+                  e.get("to_replica")) for e in verdict["reroutes"]}
+        assert moves == {(rid, 1, 0) for rid in victims}, verdict
+        assert any("declared lost" in r for r in verdict["reasons"]), \
+            verdict["reasons"]
+        assert any("rerouted" in r for r in verdict["reasons"]), \
+            verdict["reasons"]
+
+
+class TestDrillCanaryRollback:
+    def test_poisoned_generation_rolls_back_fixed_build_promotes(
+            self, tmp_path, monkeypatch):
+        """Drill (k), the canary state machine end to end on the REAL
+        weight path: generation 2 publishes through the fleet plane
+        (checkpoint commit -> publisher -> per-replica subscribers),
+        the controller claims it, holds the baseline replica's gate,
+        and steers the hashed cohort at it. Generation 2 is poisoned —
+        its decode steps cost 30x on the serving clock — so the live
+        TTFT histograms must breach and auto-roll-back: traffic to 0,
+        generation quarantined, zero requests lost, and the quarantined
+        replica drained of traffic until generation 3 (the fix) arms,
+        canaries cleanly, and promotes fleet-wide.
+
+        Replicas run on per-replica virtual clocks (the engines take a
+        ``clock``): two replicas serve in parallel in production, so
+        one replica's slow step must not bill the other's TTFT the way
+        a serial test loop would. The weights, publish/arm/gate path,
+        dispatch, and histogram math are all real."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.fleet import WeightPublisher, WeightSubscriber
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.router import CanaryController, Router
+        from horovod_tpu.serving.engine import ServeEngine
+        from horovod_tpu.serving.queue import AdmissionQueue, Request
+        from horovod_tpu.utils import checkpoint as hvd_checkpoint
+        from horovod_tpu.utils import metrics as hvd_metrics
+        from horovod_tpu.utils import tracing as hvd_tracing
+
+        monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        hvd_metrics.reset(enabled=True)
+        hvd_tracing.reset(enabled=True, rank=0)
+        try:
+            self._drill(tmp_path, jax, jnp, WeightPublisher,
+                        WeightSubscriber, tr, CanaryController, Router,
+                        ServeEngine, AdmissionQueue, Request,
+                        hvd_checkpoint, hvd_metrics, hvd_tracing)
+        finally:
+            hvd_metrics.reset()
+            hvd_tracing.reset()
+
+    def _drill(self, tmp_path, jax, jnp, WeightPublisher,
+               WeightSubscriber, tr, CanaryController, Router,
+               ServeEngine, AdmissionQueue, Request, hvd_checkpoint,
+               hvd_metrics, hvd_tracing):
+        ckpt = str(tmp_path / "ckpt")
+        cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                        attention_impl="full")
+        _, params0 = tr.init_params(cfg, jax.random.PRNGKey(0))
+        mgr = hvd_checkpoint.CheckpointManager(ckpt, rank=0,
+                                               world_size=1,
+                                               async_save=False)
+        mgr.on_commit = WeightPublisher(ckpt).publish
+        mgr.save(params0, step=0, block=True)  # generation 1
+
+        class Clock:
+            def __init__(self):
+                self.t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        clocks = {0: Clock(), 1: Clock()}
+        ctrl = CanaryController(pct=50.0, window=6, ttft_x=1.5,
+                                goodput_drop=0.10, min_delta_s=0.025,
+                                max_canary_replicas=1)
+        subs, engines = {}, {}
+        for rid in (0, 1):
+            subs[rid] = WeightSubscriber(ckpt, like=params0,
+                                         replica=rid,
+                                         poll_interval_s=0.01)
+            boot = subs[rid].load_initial()
+            engines[rid] = ServeEngine(
+                cfg, boot.params, num_slots=2, max_len=48, kv_block=8,
+                queue=AdmissionQueue(max_depth=64,
+                                     admission_timeout_s=1e9,
+                                     clock=clocks[rid]),
+                subscriber=subs[rid], swap_gate=ctrl.gate(rid),
+                clock=clocks[rid])
+
+        # per-replica serving time: a healthy step costs 10ms on that
+        # replica's clock; a step serving the poisoned generation 2
+        # costs 300ms — the regression the canary must catch
+        for rid in (0, 1):
+            def timed_step(engine=engines[rid], clk=clocks[rid]):
+                clk.t += 0.300 if engine.generation == 2 else 0.010
+                return type(engine).step(engine)
+            engines[rid].step = timed_step
+        router = Router(engines, policy="least_loaded",
+                        affinity_prefix=0, canary=ctrl)
+
+        submitted, results = [], []
+
+        def pump(n_new, tag, deadline_s=60.0):
+            """Feed ``n_new`` requests while stepping the router."""
+            i, t0 = 0, time.monotonic()
+            while (i < n_new or router.pending()) and \
+                    time.monotonic() - t0 < deadline_s:
+                if i < n_new:
+                    rid = f"{tag}-{i}"
+                    assert router.submit(Request(rid, (3, 1, 4),
+                                                 max_new_tokens=4))
+                    submitted.append(rid)
+                    i += 1
+                results.extend(router.step())
+
+        # phase 1: steady state on generation 1, both replicas serving
+        pump(6, "warm")
+        assert ctrl.state == "idle"
+
+        # phase 2: the poisoned build publishes; let the subscribers'
+        # background loads ARM it before stepping again, so the tick at
+        # the head of the next step claims it while every gate is still
+        # closed — then drive traffic until the live histograms decide
+        mgr.save(params0, step=1, block=True)  # generation 2
+        for rid in (0, 1):
+            subs[rid].poll(force=True)
+        deadline = time.monotonic() + 60.0
+        while any(subs[rid].armed_generation != 2 for rid in (0, 1)) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert all(subs[rid].armed_generation == 2 for rid in (0, 1))
+        router.step()  # the tick at its head claims generation 2
+        assert ctrl.state == "canary", ctrl.state
+        assert ctrl.canary_generation == 2
+        (canary_rid,) = ctrl.canary_replicas
+        baseline_rid = 1 - canary_rid
+        pump(40, "live")
+        assert ctrl.state == "rolled_back", ctrl.state
+        assert ctrl.quarantined == {2}
+        verdict, evidence = ctrl.decisions[-1]
+        assert verdict == "rollback"
+        assert "ttft_p99" in evidence["breaches"], evidence
+        assert evidence["ttft_p99_canary"] > \
+            1.5 * evidence["ttft_p99_baseline"], evidence
+        # the baseline replica's gate held: it never swapped to the
+        # poisoned generation, before the verdict or after
+        assert engines[baseline_rid].generation == 1
+        assert engines[canary_rid].generation == 2
+
+        # phase 3: post-rollback, the quarantined replica (still
+        # serving generation 2 — swaps are monotonic) gets NO traffic
+        before = len(results)
+        pump(6, "post")
+        drained = [x for x in results[before:]
+                   if x.request_id.startswith("post-")]
+        assert len(drained) == 6
+        assert all(x.replica == baseline_rid for x in drained), drained
+
+        # phase 4: the fixed build (generation 3) arms, canaries
+        # cleanly, and promotes; the fleet converges on it
+        mgr.save(params0, step=2, block=True)  # generation 3
+        mgr.close()
+        for rid in (0, 1):
+            subs[rid].poll(force=True)
+        deadline = time.monotonic() + 60.0
+        while any(subs[rid].armed_generation != 3 for rid in (0, 1)) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        router.step()
+        assert ctrl.state == "canary" and ctrl.canary_generation == 3
+        pump(40, "fix")
+        assert ctrl.state == "promoted", ctrl.state
+        assert ctrl.quarantined == {2}  # the bad build stays banned
+        deadline = time.monotonic() + 60.0
+        while any(engines[rid].generation != 3 for rid in (0, 1)) \
+                and time.monotonic() < deadline:
+            router.step()
+        assert all(engines[rid].generation == 3 for rid in (0, 1))
+
+        # zero requests lost across the whole incident
+        outcomes = {x.request_id: x.outcome for x in results}
+        assert sorted(outcomes) == sorted(submitted)
+        assert all(o == "completed" for o in outcomes.values())
+
+        # the dumps alone replay both verdicts
+        hvd_tracing.get_tracer().dump(reason="canary_drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_postmortem
+        loaded, bad = hvd_postmortem.load_dumps(
+            hvd_postmortem.find_dumps(str(tmp_path)))
+        assert not bad
+        hvd_postmortem.rebase(loaded)
+        pm = hvd_postmortem.analyze(loaded)
+        calls = [(e.get("event"), e.get("generation"))
+                 for e in pm["canary_decisions"]]
+        assert ("route_rollback", 2) in calls, calls
+        assert ("route_promote", 3) in calls, calls
+        assert any("ROLLED BACK" in r for r in pm["reasons"]), \
+            pm["reasons"]

@@ -1152,6 +1152,69 @@ def test_hvd016_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD017 — direct engine admission outside the router front door
+# ---------------------------------------------------------------------------
+
+def test_hvd017_triggers_on_engine_submit_and_admission_queue(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=client_path
+        from horovod_tpu.serving import AdmissionQueue
+
+        def drive(engine, requests):
+            queue = AdmissionQueue(max_depth=8)
+            for req in requests:
+                engine.submit(req)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD017"] * 2
+
+
+def test_hvd017_scopes_to_client_dirs(tmp_path):
+    # same code under examples/ fires without any role marker...
+    mod = tmp_path / "examples"
+    mod.mkdir(parents=True)
+    f = mod / "demo.py"
+    f.write_text("def go(engine, req):\n    engine.submit(req)\n")
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD017"]
+    # ...and the identical snippet with no role and no client dir is
+    # out of scope (the engine's own internals are the implementation)
+    found = lint_source(tmp_path, """\
+        def go(engine, req):
+            engine.submit(req)
+        """)
+    assert live(found) == []
+
+
+def test_hvd017_router_submit_is_sanctioned(tmp_path):
+    # Router.submit IS the front door; queue.submit inside the serving
+    # plane is somebody else's receiver
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=client_path
+
+        def drive(router, queue, requests):
+            for req in requests:
+                router.submit(req)
+            queue.submit(requests[0])
+        """)
+    assert live(found) == []
+
+
+def test_hvd017_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=client_path
+
+        def bench_arm(engine, req):
+            # hvdlint: disable=HVD017(single-replica bench arm: the bare engine is the thing measured)
+            engine.submit(req)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD017"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -1211,7 +1274,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 17)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 18)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub
@@ -1243,7 +1306,7 @@ def test_repo_lints_clean_end_to_end():
     env = dict(os.environ, PYTHONPATH=REPO_ROOT)
     out = subprocess.run(
         [sys.executable, "-m", "tools.hvdlint",
-         "horovod_tpu", "tools", "bench.py"],
+         "horovod_tpu", "tools", "bench.py", "examples"],
         capture_output=True, text=True, cwd=REPO_ROOT, env=env)
     assert out.returncode == 0, out.stdout + out.stderr
 

@@ -81,6 +81,10 @@ LEGS = (
     Leg("serve_speedup", ("serve", "speedup_tokens_per_step")),
     Leg("serve_swap_dip_pct", ("swap", "dip_pct"),
         higher_better=False),
+    Leg("route_agg_speedup", ("route", "agg_speedup_tokens_per_step")),
+    Leg("route_ll_p99_ttft_steps",
+        ("route", "least_loaded", "p99_ttft_steps"),
+        higher_better=False),
     Leg("ckpt_overhead_pct", ("ckpt", "overhead_pct"),
         higher_better=False),
     Leg("overlap_frac", ("overlap", "overlap_frac"),

@@ -294,6 +294,46 @@ def analyze(dumps):
                         f"checkpoint at step {e.get('step')} before "
                         f"exiting 45")
 
+    # 7. router plane: the front-door story. Reroute events tie a
+    # replica loss to where each orphaned request went (or why it
+    # failed); promote/rollback events carry the histogram evidence the
+    # canary verdict was made from, so "why did the rollout stop" is
+    # answerable from the dumps alone.
+    reroutes, canary_decisions = [], []
+    for d in dumps:
+        for e in d.get("events", []):
+            kind = e.get("event")
+            if kind == "route_replica_lost":
+                reasons.append(
+                    f"router: replica {e.get('replica')} declared lost "
+                    f"with {len(e.get('inflight', []))} request(s) "
+                    f"in flight: {e.get('inflight')}")
+            elif kind == "route_reroute":
+                reroutes.append({"dump_rank": _rank_of(d), **e})
+                reasons.append(
+                    f"router: request {e.get('request_id')} rerouted "
+                    f"replica {e.get('from_replica')} -> "
+                    f"{e.get('to_replica')} (attempt "
+                    f"{e.get('attempt')})")
+            elif kind in ("route_promote", "route_rollback"):
+                canary_decisions.append(
+                    {"dump_rank": _rank_of(d), **e})
+                if kind == "route_rollback":
+                    reasons.append(
+                        f"router: canary generation "
+                        f"{e.get('generation')} ROLLED BACK on "
+                        f"{e.get('breaches')} (ttft p99 canary "
+                        f"{e.get('ttft_p99_canary')} vs baseline "
+                        f"{e.get('ttft_p99_baseline')}, goodput "
+                        f"{e.get('goodput_ratio_canary')} vs "
+                        f"{e.get('goodput_ratio_baseline')})")
+                else:
+                    reasons.append(
+                        f"router: canary generation "
+                        f"{e.get('generation')} promoted after "
+                        f"{e.get('canary_n')}+{e.get('baseline_n')} "
+                        f"observations")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -343,6 +383,8 @@ def analyze(dumps):
         "weight_swaps": swaps,
         "fleet_refusals": refusals,
         "preemptions": preemptions,
+        "reroutes": reroutes,
+        "canary_decisions": canary_decisions,
     }
 
 
@@ -407,6 +449,15 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
         lines.append(f"  preemptions    : "
                      f"{len([e for e in verdict['preemptions'] if e.get('event') == 'ckpt_preempt'])} "
                      f"(emergency commit at steps {steps})")
+    if verdict.get("reroutes"):
+        moves = [(e.get("request_id"), e.get("from_replica"),
+                  e.get("to_replica")) for e in verdict["reroutes"]]
+        lines.append(f"  reroutes       : {len(moves)} {moves}")
+    if verdict.get("canary_decisions"):
+        calls = [(e.get("event"), e.get("generation"),
+                  e.get("breaches", [])) for e in
+                 verdict["canary_decisions"]]
+        lines.append(f"  canary verdicts: {calls}")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -457,7 +508,10 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
                                   "numerics_anomaly", "serve_failover",
                                   "slow_decode_tick", "fleet_publish",
                                   "fleet_swap", "fleet_refuse",
-                                  "ckpt_preempt", "ckpt_emergency_exit"):
+                                  "ckpt_preempt", "ckpt_emergency_exit",
+                                  "route_replica_lost", "route_reroute",
+                                  "route_canary_begin", "route_promote",
+                                  "route_rollback"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
@@ -512,7 +566,9 @@ def chrome_trace(dumps, stitched):
                         "chaos_injection", "numerics_anomaly",
                         "serve_failover", "fleet_publish", "fleet_swap",
                         "fleet_refuse", "ckpt_preempt",
-                        "ckpt_emergency_exit"):
+                        "ckpt_emergency_exit", "route_replica_lost",
+                        "route_reroute", "route_canary_begin",
+                        "route_promote", "route_rollback"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

@@ -152,6 +152,41 @@ def _dominant(record):
     return max(PHASES, key=lambda p: phases.get(p, 0.0))
 
 
+def _rollup_by_replica(records, tail):
+    """Per-replica tail rollup: how many requests each replica (dump
+    rank) contributed overall and to the tail, the tail's mean latency
+    and dominant phase per replica — the router drills use this to
+    attribute a slow p99 to the replica that caused it."""
+    by = {}
+    for r in records:
+        b = by.setdefault(r.get("rank"), {
+            "requests": 0, "inflight": 0, "tail_requests": 0,
+            "_tail_total_ms": 0.0, "_votes": collections.Counter()})
+        b["requests"] += 1
+        if r["inflight"]:
+            b["inflight"] += 1
+    for r in tail:
+        b = by[r.get("rank")]
+        b["tail_requests"] += 1
+        b["_tail_total_ms"] += r["total_ms"]
+        d = _dominant(r)
+        if d:
+            b["_votes"][d] += 1
+    out = {}
+    for rank, b in by.items():
+        out[str(rank)] = {
+            "requests": b["requests"],
+            "inflight": b["inflight"],
+            "tail_requests": b["tail_requests"],
+            "tail_mean_ms": (round(b["_tail_total_ms"] /
+                                   b["tail_requests"], 3)
+                             if b["tail_requests"] else 0.0),
+            "tail_dominant_phase": (b["_votes"].most_common(1)[0][0]
+                                    if b["_votes"] else None),
+        }
+    return out
+
+
 def analyze_serve(dumps, pct=None):
     """The tail verdict: which phase owns the slow requests, and why.
 
@@ -159,7 +194,10 @@ def analyze_serve(dumps, pct=None):
     (always at least one), classifies each by its dominant phase, and
     votes. A queue_wait/requeue-dominated tail whose requests were
     bounced back by the block ledger (requeues > 0) is flagged as KV
-    pressure — the queue was not slow, the cache was full.
+    pressure — the queue was not slow, the cache was full. With dumps
+    from multiple replicas the tail is also rolled up per replica
+    (``by_replica``); a replica owning the majority of the tail is
+    named ``tail_replica`` in the verdict.
     """
     if pct is None:
         pct = float(os.environ.get("HVD_SLO_PCT", "90"))
@@ -175,6 +213,8 @@ def analyze_serve(dumps, pct=None):
         "kv_pressure": False,
         "verdict": "no serve requests in the dumps",
         "phase_mean_ms": {},
+        "by_replica": {},
+        "tail_replica": None,
     }
     if not records:
         return out
@@ -187,6 +227,7 @@ def analyze_serve(dumps, pct=None):
         p: round(sum((r["phase_ms"] or {}).get(p, 0.0)
                      for r in tail) / len(tail), 3)
         for p in PHASES}
+    out["by_replica"] = _rollup_by_replica(records, tail)
     if not votes:
         out["verdict"] = (f"p{pct:g}: {len(tail)} tail request(s) carry "
                           "no phase decomposition (tracing off?)")
@@ -203,6 +244,14 @@ def analyze_serve(dumps, pct=None):
     if out["inflight"]:
         verdict += (f"; {len(out['inflight'])} request(s) still in "
                     f"flight at dump time: {out['inflight']}")
+    if len(out["by_replica"]) > 1:
+        worst = max(out["by_replica"].items(),
+                    key=lambda kv: kv[1]["tail_requests"])
+        if worst[1]["tail_requests"] * 2 > len(tail):
+            out["tail_replica"] = worst[0]
+            verdict += (f"; tail concentrated on replica {worst[0]} "
+                        f"({worst[1]['tail_requests']}/{len(tail)} "
+                        f"tail requests)")
     out["verdict"] = verdict
     return out
 
@@ -243,6 +292,21 @@ def render_report(dumps, verdict):
         lines.append("")
         lines.append("  tail phase means (ms): " + "  ".join(
             f"{p}={v:g}" for p, v in verdict["phase_mean_ms"].items()))
+    by_replica = verdict.get("by_replica") or {}
+    if len(by_replica) > 1:
+        lines.append("")
+        lines.append("-- per-replica tail rollup " + "-" * 45)
+        lines.append(f"  {'replica':<10}{'requests':>10}{'inflight':>10}"
+                     f"{'tail':>7}{'tail mean':>12}  dominant")
+        for rank in sorted(by_replica, key=str):
+            b = by_replica[rank]
+            mark = ("  <- tail replica"
+                    if str(rank) == str(verdict.get("tail_replica"))
+                    else "")
+            lines.append(
+                f"  {rank:<10}{b['requests']:>10}{b['inflight']:>10}"
+                f"{b['tail_requests']:>7}{b['tail_mean_ms']:>10.1f}ms"
+                f"  {b['tail_dominant_phase'] or '-'}{mark}")
     lines.append("")
     return "\n".join(lines)
 
@@ -334,14 +398,16 @@ class _FakeUsClock:
             self.now_us if ts_us is None else ts_us)
 
 
-def _synthetic_dump(slow_phase):
+def _synthetic_dump(slow_phase, rank=0, n_slow=3):
     """Build one rank's flight dump from a real Tracer fed synthetic
-    request lifecycles: 9 fast requests plus 3 whose ``slow_phase``
-    (queue_wait-with-requeues, or prefill) is 100x slower."""
+    request lifecycles: 9 fast requests plus ``n_slow`` whose
+    ``slow_phase`` (queue_wait-with-requeues, or prefill) is 100x
+    slower. ``rank`` labels the dump — the multi-replica rollup keys
+    replicas off it."""
     from horovod_tpu.serving import tracing as serve_tracing
 
     clock = _FakeUsClock()
-    tracer = hvd_tracing.Tracer(rank=0, clock=clock)
+    tracer = hvd_tracing.Tracer(rank=rank, clock=clock)
 
     def one_request(rid, queue_ms, prefill_ms, decode_ms, requeues=0):
         trace = serve_tracing.RequestTrace(tracer, rid).on_submit()
@@ -359,12 +425,13 @@ def _synthetic_dump(slow_phase):
         trace.on_retire("completed", tokens=8)
 
     for i in range(9):
-        one_request(f"fast-{i}", 1.0, 2.0, 10.0)
-    for i in range(3):
+        one_request(f"fast-r{rank}-{i}", 1.0, 2.0, 10.0)
+    for i in range(n_slow):
         if slow_phase == "queue_wait":
-            one_request(f"slow-{i}", 200.0, 2.0, 10.0, requeues=3)
+            one_request(f"slow-r{rank}-{i}", 200.0, 2.0, 10.0,
+                        requeues=3)
         else:
-            one_request(f"slow-{i}", 1.0, 400.0, 10.0)
+            one_request(f"slow-r{rank}-{i}", 1.0, 400.0, 10.0)
     return tracer.flight_snapshot(f"selftest-{slow_phase}")
 
 
@@ -379,6 +446,18 @@ def selftest():
     pf = analyze_serve([_synthetic_dump("prefill")])
     assert pf["dominant_phase"] == "prefill", pf
     assert not pf["kv_pressure"], pf
+
+    # multi-replica rollup: replica 1's dump carries the slow tail,
+    # replica 0's is all-fast — the verdict must name replica 1
+    multi = analyze_serve([_synthetic_dump("prefill", rank=0, n_slow=0),
+                           _synthetic_dump("prefill", rank=1, n_slow=3)])
+    assert set(multi["by_replica"]) == {"0", "1"}, multi
+    assert multi["tail_replica"] == "1", multi
+    assert multi["by_replica"]["1"]["tail_requests"] > \
+        multi["by_replica"]["0"]["tail_requests"], multi
+    assert "replica 1" in multi["verdict"], multi
+    multi_report = render_report([], multi)
+    assert "tail replica" in multi_report, multi_report
 
     # the report and the trace must render without error
     dumps = [_synthetic_dump("queue_wait")]

@@ -514,6 +514,56 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
                 lines.append(f"    {phase:<17} p50 {_fmt_s(sp50):>8}"
                              f"   p99 {_fmt_s(sp99):>8}")
 
+    # router plane: front-door dispatch, affinity stickiness, and the
+    # live canary rollout (horovod_tpu/router/; docs/routing.md)
+    by_dest = _by_label(snap, "hvd_route_requests_total", "replica")
+    live = _total(snap, "hvd_route_replicas_live")
+    if by_dest or live:
+        lines.append(c(BOLD, "  router"))
+        dest_s = "  ".join(
+            f"{r}={int(v):,}" for r, v in
+            sorted(by_dest.items(), key=lambda kv: str(kv[0])))
+        rerouted = _total(snap, "hvd_route_rerouted_total")
+        d_line = (f"    dispatch      live {int(live):,}   "
+                  f"to {dest_s or '-'}   rerouted {int(rerouted):,}")
+        lines.append(c(YELLOW, d_line) if rerouted else d_line)
+        aff = _by_label(snap, "hvd_route_affinity_total", "outcome")
+        if aff:
+            total_aff = sum(aff.values()) or 1.0
+            lines.append(
+                f"    affinity      hit {int(aff.get('hit', 0)):,} "
+                f"({aff.get('hit', 0) / total_aff:>4.0%})   "
+                f"miss {int(aff.get('miss', 0)):,}   "
+                f"overflow {int(aff.get('overflow', 0)):,}")
+        gen_fam = snap.get("metrics", {}).get(
+            "hvd_route_canary_generation")
+        can_gen = (gen_fam["values"][0].get("value")
+                   if gen_fam and gen_fam.get("values") else None)
+        if can_gen is not None and can_gen >= 0:
+            frac = _total(snap, "hvd_route_canary_fraction")
+            state = "promoted" if frac >= 100 else "evaluating"
+            can_line = (f"    canary        generation "
+                        f"{int(can_gen):,}   traffic {frac:.0f}%   "
+                        f"{state}")
+            lines.append(can_line if frac >= 100
+                         else c(YELLOW, can_line))
+            ch = snap.get("metrics", {}).get(
+                "hvd_route_canary_ttft_seconds")
+            if ch and ch.get("values"):
+                bounds = ch.get("buckets", [])
+                for v in sorted(ch["values"], key=lambda x: x.get(
+                        "labels", {}).get("cohort", "")):
+                    cohort = v.get("labels", {}).get("cohort", "?")
+                    counts = v.get("counts", [])
+                    cp50 = hvd_metrics.histogram_quantile(bounds,
+                                                          counts, 0.5)
+                    cp99 = hvd_metrics.histogram_quantile(bounds,
+                                                          counts, 0.99)
+                    lines.append(f"    ttft {cohort:<8} reqs "
+                                 f"{v.get('count', 0):>9,}   "
+                                 f"p50 {_fmt_s(cp50):>8}   "
+                                 f"p99 {_fmt_s(cp99):>8}")
+
     # tracing plane: per-stage span latency + the slow-span tail
     span_entry = snap.get("metrics", {}).get("hvd_span_seconds")
     slow = [e for e in snap.get("events", [])
@@ -554,10 +604,12 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
         for ev in events:
             kind = ev.get("event", "?")
             code = RED if kind in ("ranks_lost", "stall_kill",
-                                   "numerics_anomaly",
-                                   "serve_failover") else (
+                                   "numerics_anomaly", "serve_failover",
+                                   "route_rollback",
+                                   "route_replica_lost") else (
                 YELLOW if kind in ("stall", "chaos_injection",
-                                   "serve_reject") else DIM)
+                                   "serve_reject",
+                                   "route_reroute") else DIM)
             detail = {k: v for k, v in ev.items()
                       if k not in ("event", "ts_us", "epoch_us")}
             lines.append(c(code, f"    [{ev.get('ts_us', 0) / 1e6:>9.3f}s] "
@@ -729,6 +781,28 @@ def canned_snapshot():
                      ("armed_to_swapped", 0.05), ("total", 0.81)):
         for _ in range(16):
             fs.labels(phase=phase).observe(v)
+    rr = reg.counter("hvd_route_requests_total", "c",
+                     labels=("replica",))
+    rr.labels(replica="0").inc(1_020)
+    rr.labels(replica="1").inc(980)
+    reg.counter("hvd_route_rerouted_total", "c").inc(2)
+    ra = reg.counter("hvd_route_affinity_total", "c",
+                     labels=("outcome",))
+    ra.labels(outcome="hit").inc(612)
+    ra.labels(outcome="miss").inc(74)
+    ra.labels(outcome="overflow").inc(9)
+    reg.gauge("hvd_route_replicas_live", "g").set(2)
+    reg.gauge("hvd_route_canary_generation", "g").set(18)
+    reg.gauge("hvd_route_canary_fraction", "g").set(10)
+    ct = reg.histogram("hvd_route_canary_ttft_seconds", "h",
+                       labels=("cohort",),
+                       buckets=hvd_metrics.SERVE_PHASE_BUCKETS)
+    for _ in range(40):
+        ct.labels(cohort="baseline").observe(0.03)
+    for _ in range(5):
+        ct.labels(cohort="canary").observe(0.04)
+    reg.event("route_reroute", request_id="req-9810", from_replica=1,
+              to_replica=0, attempt=1, waited_s=0.42)
     reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",
               trace_id="r1.42", dur_ms=412.5, status="ok")
     reg.event("serve_reject", request_id="req-9917", reason="queue_full",

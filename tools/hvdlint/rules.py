@@ -1265,6 +1265,58 @@ def check_full_tree_barrier(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD017 — direct engine admission outside the router front door
+# ---------------------------------------------------------------------------
+
+# client-side surfaces that should reach the serving plane through the
+# Router (horovod_tpu/router/), never a bare engine; fixtures opt in
+# with `# hvdlint: role=client_path`
+_CLIENT_DIRS = ("examples/", "tools/")
+# receiver names that read as "a ServeEngine" at a call site
+_ENGINE_RECEIVERS = {"engine", "eng", "serve_engine", "serving_engine"}
+_ADMISSION_CTORS = {"AdmissionQueue"}
+
+
+def check_direct_engine_submit(ctx, shared):
+    if "client_path" not in ctx.roles and not any(
+            d in ctx.relpath for d in _CLIENT_DIRS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if (chain is not None and len(chain) >= 2 and
+                chain[-1] == "submit" and
+                chain[-2] in _ENGINE_RECEIVERS):
+            yield Finding(
+                "HVD017", ctx.relpath, node.lineno, node.col_offset,
+                "direct ServeEngine.submit in a client surface: a "
+                "request admitted behind the router's back is "
+                "invisible to the dispatch ledger — it skips load "
+                "scoring and cache affinity, its result carries no "
+                "replica stamp, a canary rollout cannot steer or "
+                "observe it, and when the replica dies nobody reroutes "
+                "it. The router (horovod_tpu/router/) is the ONE "
+                "admission point for multi-replica serving "
+                "(docs/routing.md). Submit through Router.submit, or "
+                "keep a direct call only with a disable/baseline "
+                "reason naming why a single bare engine is the point.")
+        elif ((chain is not None and chain[-1] in _ADMISSION_CTORS) or
+              (isinstance(node.func, ast.Name) and
+               node.func.id in _ADMISSION_CTORS)):
+            yield Finding(
+                "HVD017", ctx.relpath, node.lineno, node.col_offset,
+                "direct AdmissionQueue construction in a client "
+                "surface: hand-building the admission path couples the "
+                "caller to one engine's queue and bypasses the "
+                "router's single front door — no load-aware dispatch, "
+                "no reroute on replica loss, no canary cohorting "
+                "(docs/routing.md). Front the engines with a Router, "
+                "or carry a disable/baseline reason naming why this "
+                "tool is deliberately single-replica.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1732,5 +1784,48 @@ Fix: enqueue in reverse layer order with
 bucket as consumed; for device sync, rely on instrument_step's
 boundary or carry a disable reason naming what must materialize.""",
             check_full_tree_barrier),
+        Rule(
+            "HVD017", "direct-engine-submit",
+            "ServeEngine.submit / AdmissionQueue use in client "
+            "surfaces outside the router front door",
+            """HVD017 — direct engine admission outside the router
+
+The router plane (horovod_tpu/router/, docs/routing.md) gives
+multi-replica serving exactly one admission point: ``Router.submit``
+scores every live replica's heartbeat-carried load snapshot, applies
+cache-affinity stickiness, records the assignment in the reroute
+ledger, and lets the canary controller steer the request's cohort.
+Everything downstream depends on admission going through it: a
+request submitted straight into a ``ServeEngine`` is invisible to the
+ledger (nobody reroutes it when its replica dies), skips load scoring
+(it lands on whichever engine the caller happened to hold, however
+loaded), carries no replica stamp in its result, and punches through
+a canary rollout's traffic split — the SLO comparison silently loses
+samples to the wrong cohort.
+
+The historical shape this rule pins: single-engine demo code
+(examples/serve_lm.py, tools/hvd_fleet.py) copy-pasted into a
+multi-replica deployment, where "submit to the engine I have" becomes
+a second, unrouted front door.
+
+Flags, in ``examples/`` and ``tools/`` (fixtures opt in with
+``# hvdlint: role=client_path``):
+
+  * calls whose attribute chain ends ``.submit`` on an engine-ish
+    receiver (engine / eng / serve_engine / serving_engine) —
+    ``Router.submit`` (receiver ``router``) is the sanctioned call;
+  * ``AdmissionQueue(...)`` construction — hand-building the
+    admission path couples the caller to one engine's queue.
+
+``horovod_tpu/`` itself is out of scope: the router and the engine's
+own internals are the implementation, not a client. The baselined
+sites are the deliberately single-replica ones: serve_lm.py's
+policy-comparison arms (fresh engine per arm IS the experiment) and
+hvd_fleet's drill (one victim replica by design).
+
+Fix: front the engines with a ``Router`` (it accepts one replica
+fine) and submit through it; keep a direct call only with a reason
+naming why a bare single engine is the point.""",
+            check_direct_engine_submit),
     ]
 }
