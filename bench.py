@@ -1311,6 +1311,130 @@ def _bench_route(on_tpu):
     return out
 
 
+def _bench_elastic(on_tpu):
+    """Overload-shedding A/B gate (docs/elasticity.md): the SAME 2x
+    Poisson open-loop overload against the same 2-replica fleet twice —
+    once with the admission shed gate disabled (control) and once with
+    the production gate (``HVD_ELASTIC_SHED_DEPTH``) engaged. Enforced
+    (AssertionError):
+
+      * the control arm admits everything, so under open-loop overload
+        its backlog grows without bound and its admitted p99 TTFT (in
+        scheduler steps — the same deterministic first-token-step
+        accounting as ``_bench_route``) degrades to >=2x the shed
+        arm's, while the shed arm's bounded queues hold TTFT down;
+      * the shed arm rejects at admission (>=1 shed,
+        completed + shed == offered) and EVERY rejection carries a
+        positive retry-after hint priced from the observed drain rate;
+      * nothing is lost in either arm — every offered request is
+        either completed or explicitly shed, never silently dropped.
+
+    The overload is open-loop (arrivals never adapt to the engine), so
+    the control arm's degradation is structural, not timing luck: at 2x
+    the sustainable rate the queue grows by about one request every two
+    steps and late arrivals inherit the whole backlog."""
+    import jax
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"))
+    from serve_lm import make_workload, serving_config
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.router import Router
+    from horovod_tpu.serving import AdmissionQueue, ServeEngine
+
+    cfg = serving_config(on_tpu)
+    _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    slots, max_len, kv_block = 2, 64, 8
+    # Long enough to matter: under 2x overload the control backlog
+    # grows ~0.25 req/step, so the degradation gate needs enough
+    # arrivals for the queue to visibly diverge (40 was marginal:
+    # control p99 only 1.7x the shed arm's).
+    n_requests = 96 if on_tpu else 72
+    # 2 replicas x 2 slots decode ~4 tokens/step; the bimodal mix
+    # averages ~16 tokens/request, so ~0.25 req/step is the sustainable
+    # ceiling and rate=0.5 is the honest 2x overload.
+    rate = 0.5
+
+    def build_engine():
+        queue = AdmissionQueue(max_depth=n_requests + 8,
+                               admission_timeout_s=1e9)
+        return ServeEngine(cfg, params, num_slots=slots,
+                           max_len=max_len, kv_block=kv_block,
+                           queue=queue, seed=0)
+
+    def run_arm(workload, shed_depth, max_steps=100000):
+        router = Router({0: build_engine(), 1: build_engine()},
+                        policy="least_loaded", shed_depth=shed_depth)
+        arrivals = {req.request_id: t for t, req in workload}
+        results, sheds = [], []
+        i, steps = 0, 0
+        while i < len(workload) or router.pending():
+            while i < len(workload) and workload[i][0] <= steps:
+                req = workload[i][1]
+                if not router.submit(req):
+                    sheds.append(dict(router.last_shed))
+                i += 1
+            results.extend((r, steps) for r in router.step())
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"elastic bench never drained ({len(results)} done)")
+        done = [(r, s) for r, s in results if r.outcome == "completed"]
+        ttft = sorted((s - (len(r.tokens) - 1)) - arrivals[r.request_id]
+                      for r, s in done)
+        p99 = (ttft[min(len(ttft) - 1, int(0.99 * len(ttft)))]
+               if ttft else 0.0)
+        reasons = {}
+        for s in sheds:
+            reasons[s["reason"]] = reasons.get(s["reason"], 0) + 1
+        return {
+            "offered": len(workload),
+            "completed": len(done),
+            "shed": len(sheds),
+            "shed_reasons": reasons,
+            "p99_ttft_steps": round(p99, 2),
+            "steps": steps,
+        }, sheds
+
+    # untimed warmup compiles every prefill pad variant + decode step
+    run_arm(make_workload(seed=7, n_requests=6, rate=1.0), 0)
+
+    workload = make_workload(seed=0, n_requests=n_requests, rate=rate)
+    control, _ = run_arm(workload, 0)
+    shed_depth = 2
+    shed, shed_records = run_arm(workload, shed_depth)
+
+    out = {
+        "requests": n_requests,
+        "replicas": 2,
+        "rate_req_per_step": rate,
+        "shed_depth": shed_depth,
+        "control": control,
+        "shed": shed,
+        "retry_after_s_first": (shed_records[0]["retry_after_s"]
+                                if shed_records else None),
+    }
+    assert control["shed"] == 0 and \
+        control["completed"] == n_requests, (
+            f"control arm (shedding off) must admit and finish "
+            f"everything: {out}")
+    assert shed["shed"] >= 1, (
+        f"2x overload never tripped the shed gate at depth "
+        f"{shed_depth}: {out}")
+    assert shed["completed"] + shed["shed"] == n_requests, (
+        f"shed arm lost requests — completed + shed != offered: {out}")
+    assert all(s.get("retry_after_s", 0) > 0 for s in shed_records), (
+        f"a rejection went out without a positive retry-after hint: "
+        f"{shed_records[:4]}")
+    assert control["p99_ttft_steps"] >= \
+        2.0 * max(shed["p99_ttft_steps"], 1.0), (
+            f"the control arm's admitted p99 TTFT "
+            f"{control['p99_ttft_steps']} steps is not >=2x the shed "
+            f"arm's {shed['p99_ttft_steps']} — the front door bought "
+            f"nothing: {out}")
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -1614,6 +1738,14 @@ def main():
     route = None
     if os.environ.get("HVD_BENCH_ROUTE", "") != "0":
         route = _bench_route(on_tpu)
+    # Elasticity-plane shed gate: under the same 2x Poisson overload
+    # the admission shed gate must hold admitted p99 TTFT while the
+    # no-shed control degrades >=2x, and every rejection must carry a
+    # positive retry-after; ENFORCED (AssertionError).
+    # HVD_BENCH_ELASTIC=0 skips it.
+    elastic = None
+    if os.environ.get("HVD_BENCH_ELASTIC", "") != "0":
+        elastic = _bench_elastic(on_tpu)
     # Checkpoint-plane overhead gate: async double-buffered saves every
     # step vs no checkpointing around a calibrated training-shaped
     # step; the <=2% budget is ENFORCED (AssertionError), the
@@ -1798,6 +1930,7 @@ def main():
         "serve": serve,
         "swap": swap,
         "route": route,
+        "elastic": elastic,
         "ckpt": ckpt,
         "perf_attrib": perf_attrib,
         "metrics": metrics_snap,

@@ -123,6 +123,18 @@ echo "--- router plane (fast fail: dispatch scoring, affinity, reroute ledger, c
 # replica-loss and poisoned-canary drills ride test_chaos_plane.py.
 python -m pytest tests/test_router.py -q -m "not slow"
 
+echo "--- elasticity plane (fast fail: autoscale hysteresis, grading, drain, breakers, shed)"
+# The elasticity plane (docs/elasticity.md) turns the router's SLO
+# windows into replica count: scale decisions with dwell/cooldown
+# hysteresis, graceful drain with exactly-once reroute past the bound,
+# admission shedding with priced retry-after, and per-replica circuit
+# breakers that catch wedged-but-heartbeating replicas. The suite is
+# process-local (virtual clocks, synthetic load snapshots, tiny-model
+# drain runs) and fast; the full-fleet drills (planned scale-down with
+# exact parity, flap storm + rollback, wedged-replica isolation) ride
+# test_chaos_plane.py with the other drills.
+python -m pytest tests/test_elasticity.py -q -m "not slow"
+
 echo "--- perf attribution (fast fail: overlap math, roofline model, regression ledger)"
 # The perf-attribution plane (docs/profiling.md) is how every other
 # plane's "is it fast enough" question gets answered: trace

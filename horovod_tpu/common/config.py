@@ -265,6 +265,54 @@ ENV_REGISTRY = (
      "contact (0 disables)."),
     ("HOROVOD_CYCLE_TIME", True, "5.0", "common/config.py",
      "Negotiation cycle time in milliseconds."),
+    ("HOROVOD_ELASTIC_BREAKER_CLOSE_N", True, "3", "router/elastic.py",
+     "Circuit breaker: consecutive successful completions a half-open "
+     "replica must serve before its breaker closes again."),
+    ("HOROVOD_ELASTIC_BREAKER_FAILS", True, "3", "router/elastic.py",
+     "Circuit breaker: consecutive failed dispatches that trip a "
+     "replica's breaker open (probe traffic only until it recovers)."),
+    ("HOROVOD_ELASTIC_BREAKER_TIMEOUT_S", True, "10.0",
+     "router/elastic.py",
+     "Circuit breaker: a live replica holding a dispatched request "
+     "longer than this without completing is declared wedged and its "
+     "breaker trips — catches the heartbeating-but-stuck failure the "
+     "liveness ledger cannot see."),
+    ("HOROVOD_ELASTIC_COOLDOWN_S", True, "10.0", "router/elastic.py",
+     "Elasticity: minimum seconds between executed scale changes; "
+     "with the dwell requirement this is the anti-flap hysteresis."),
+    ("HOROVOD_ELASTIC_DOWN_UTIL", True, "0.25", "router/elastic.py",
+     "Elasticity: scale down when fleet slot utilization stays at or "
+     "below this fraction (and the queue is empty) for the dwell "
+     "window."),
+    ("HOROVOD_ELASTIC_DRAIN_TIMEOUT_S", True, "30.0",
+     "router/core.py",
+     "Graceful drain: seconds a DRAINING replica gets to finish its "
+     "in-flight work before the router force-retires it and reroutes "
+     "the remainder through the exactly-once ledger."),
+    ("HOROVOD_ELASTIC_DWELL_S", True, "5.0", "router/elastic.py",
+     "Elasticity: a pressure or idle signal must hold continuously "
+     "this long before a scale decision executes (one blip never "
+     "moves the fleet)."),
+    ("HOROVOD_ELASTIC_MAX_REPLICAS", True, "0", "router/elastic.py",
+     "Elasticity: ceiling on live replicas for scale-up (0 = "
+     "unbounded)."),
+    ("HOROVOD_ELASTIC_MIN_REPLICAS", True, "1", "router/elastic.py",
+     "Elasticity: floor on live replicas — scale-down never drains "
+     "below it."),
+    ("HOROVOD_ELASTIC_PROBE_S", True, "2.0", "router/elastic.py",
+     "Circuit breaker: seconds between single probe requests admitted "
+     "to an open replica to test recovery."),
+    ("HOROVOD_ELASTIC_SHED_DEPTH", True, "16", "router/core.py",
+     "Overload shedding: Router.submit rejects at admission (with a "
+     "retry-after derived from the drain rate) when every usable "
+     "replica's queue depth reaches this, or all are KV-exhausted "
+     "(0 disables shedding)."),
+    ("HOROVOD_ELASTIC_TTFT_SLO_S", True, "1.0", "router/elastic.py",
+     "Elasticity: rolling-window p99 TTFT above this is scale-up "
+     "pressure even when queues look shallow."),
+    ("HOROVOD_ELASTIC_UP_DEPTH", True, "4.0", "router/elastic.py",
+     "Elasticity: mean queue depth per live replica at or above this "
+     "is scale-up pressure."),
     ("HOROVOD_FLEET_POLL_S", True, "0.5", "fleet/subscriber.py",
      "Fleet plane: seconds between publication-pointer polls by a "
      "serving replica's WeightSubscriber (the fast path is one stat)."),
@@ -386,6 +434,11 @@ ENV_REGISTRY = (
      "Router plane: max age (seconds since dispatch) a request may be "
      "requeued to a survivor after its replica is lost; older "
      "requests fail loudly instead of resurrecting."),
+    ("HOROVOD_ROUTE_STALE_S", True, "5.0", "router/core.py",
+     "Router plane: exclude a replica from dispatch once its load "
+     "snapshot is older than this — a silent replica ages out instead "
+     "of scoring as freshly idle forever (0 disables; never-reported "
+     "replicas get this long as a post-add grace window)."),
     ("HOROVOD_SERVE_ADMISSION_TIMEOUT_S", True, "10.0",
      "serving/queue.py",
      "Serving admission control: reject a queued request after waiting "
@@ -506,6 +559,10 @@ ENV_REGISTRY = (
     ("HVD_BENCH_PERF", False, None, "bench.py",
      "Set 0 to skip the perf-attribution overhead gate (periodic "
      "instrument_step capture amortized <=2% vs attribution off)."),
+    ("HVD_BENCH_ELASTIC", False, None, "bench.py",
+     "Set 0 to skip the overload-shedding bench leg (shed arm must "
+     "hold admitted p99 TTFT under 2x Poisson overload while the "
+     "unshed control degrades; every rejection carries retry-after)."),
     ("HVD_BENCH_NUMERICS", False, None, "bench.py",
      "Set 0 to skip the numerics-overhead gate in bench.py."),
     ("HVD_BENCH_OVERLAP", False, None, "bench.py",

@@ -617,7 +617,15 @@ class CoordinatorService(network.BasicService):
                 if req.metrics is not None:
                     self.metrics_snapshots[req.rank] = req.metrics
                 if getattr(req, "load", None) is not None:
-                    self.load_snapshots[req.rank] = req.load
+                    # receipt-stamped: the router's staleness exclusion
+                    # (HVD_ROUTE_STALE_S, docs/elasticity.md) compares
+                    # this ``ts`` — stamped HERE, on the coordinator's
+                    # clock, the same clock domain the rank-0 router
+                    # reads — against its dispatch time, so a replica
+                    # that heartbeated and went silent stops looking
+                    # freshly idle forever
+                    self.load_snapshots[req.rank] = dict(
+                        req.load, ts=time.monotonic())
                 if req.flight is not None:
                     path = hvd_tracing.write_remote_dump(
                         req.flight, rank=req.rank)

@@ -4,16 +4,22 @@
 load snapshots with cache-affinity stickiness, reroutes on replica
 loss, and — through ``CanaryController`` (canary.py) — rolls weight
 generations out by traffic fraction, gated on live SLO histograms.
-Policies live in policy.py; the full story is docs/routing.md.
+``ElasticityController`` + ``CircuitBreaker`` (elastic.py) close the
+loop from SLO pressure to replica-set changes: autoscaling with
+graceful drain, overload shedding, and per-replica breakers.
+Policies live in policy.py; the full story is docs/routing.md and
+docs/elasticity.md.
 """
 
-from .canary import CanaryController
+from .canary import CanaryController, SLOWindow, slo_breaches
 from .core import ReplicaHandle, Router
+from .elastic import CircuitBreaker, ElasticityController
 from .policy import (AFFINITY_SLACK, POLICIES, LeastLoaded, RoundRobin,
                      prefix_key, resolve, score)
 
 __all__ = [
-    "Router", "ReplicaHandle", "CanaryController", "resolve", "score",
-    "prefix_key", "RoundRobin", "LeastLoaded", "POLICIES",
-    "AFFINITY_SLACK",
+    "Router", "ReplicaHandle", "CanaryController", "SLOWindow",
+    "slo_breaches", "ElasticityController", "CircuitBreaker",
+    "resolve", "score", "prefix_key", "RoundRobin", "LeastLoaded",
+    "POLICIES", "AFFINITY_SLACK",
 ]

@@ -55,6 +55,87 @@ def _hash_pct(request_id):
     return int(digest[:8], 16) % 100
 
 
+def intertoken_gap(result):
+    """Mean inter-token gap of one completed RequestResult, or None
+    when it has fewer than two tokens or no phase breakdown."""
+    tokens = len(result.tokens)
+    if tokens > 1 and result.phase_ms:
+        return result.phase_ms.get("decode", 0.0) / 1e3 / (tokens - 1)
+    return None
+
+
+class SLOWindow:
+    """One cohort's SLO accumulator: TTFT + inter-token histograms and
+    goodput/wasted token counts over a window of terminal results.
+
+    Shared between the canary rollout (per-cohort windows) and the
+    elasticity controller (before/after windows around a topology
+    change, router/elastic.py) so a scale decision is graded by
+    EXACTLY the math that grades a weight rollout — one definition of
+    "the SLO got worse", not two that drift."""
+
+    __slots__ = ("ttft", "intertoken", "goodput_tokens",
+                 "wasted_tokens", "n")
+
+    def __init__(self):
+        buckets = hvd_metrics.SERVE_PHASE_BUCKETS
+        self.ttft = hvd_metrics.Histogram(buckets)
+        self.intertoken = hvd_metrics.Histogram(buckets)
+        self.goodput_tokens = 0
+        self.wasted_tokens = 0
+        self.n = 0
+
+    def observe(self, result):
+        """Fold one terminal RequestResult in. Returns the inter-token
+        gap it contributed (None if none) so callers can mirror the
+        observation into their own cumulative metrics."""
+        tokens = len(result.tokens)
+        gap = None
+        if result.outcome == "completed":
+            self.goodput_tokens += tokens
+            if result.ttft_s is not None:
+                self.ttft.observe(result.ttft_s)
+            gap = intertoken_gap(result)
+            if gap is not None:
+                self.intertoken.observe(gap)
+        else:
+            self.wasted_tokens += tokens
+        self.n += 1
+        return gap
+
+    def ttft_p99(self):
+        return hvd_metrics.histogram_quantile(
+            self.ttft.bounds, self.ttft.counts, 0.99)
+
+    def intertoken_p99(self):
+        return hvd_metrics.histogram_quantile(
+            self.intertoken.bounds, self.intertoken.counts, 0.99)
+
+    def goodput_ratio(self):
+        total = self.goodput_tokens + self.wasted_tokens
+        return self.goodput_tokens / total if total else 1.0
+
+
+def slo_breaches(candidate, baseline, ttft_x, min_delta_s, goodput_drop):
+    """The shared verdict: which SLO dimensions did ``candidate`` (an
+    SLOWindow) breach against ``baseline``? A latency breach needs both
+    the ratio (> ``ttft_x``) and an absolute gap (> ``min_delta_s``):
+    fixed-bucket p99s quantize to bucket edges, so two statistically
+    identical sub-bucket populations can read as a large *ratio* — the
+    delta floor keeps the verdict above the histogram's resolution."""
+    breaches = []
+    for key, c, b in (
+            ("ttft_p99", candidate.ttft_p99(), baseline.ttft_p99()),
+            ("intertoken_p99", candidate.intertoken_p99(),
+             baseline.intertoken_p99())):
+        if (c is not None and b is not None and
+                c > ttft_x * b and c - b > min_delta_s):
+            breaches.append(key)
+    if candidate.goodput_ratio() < baseline.goodput_ratio() - goodput_drop:
+        breaches.append("goodput_ratio")
+    return breaches
+
+
 class CanaryController:
     """Owns the rollout state machine; the Router consults ``filter``
     per dispatch and feeds ``observe``/``tick``; engines take
@@ -180,21 +261,11 @@ class CanaryController:
             return
         cohort = ("canary" if result.generation == self.canary_generation
                   else "baseline")
-        st = self._stats[cohort]
-        tokens = len(result.tokens)
-        if result.outcome == "completed":
-            st["goodput_tokens"] += tokens
-            if result.ttft_s is not None:
-                st["ttft"].observe(result.ttft_s)
-                self._m_ttft.labels(cohort=cohort).observe(result.ttft_s)
-            if tokens > 1 and result.phase_ms:
-                gap = (result.phase_ms.get("decode", 0.0) / 1e3 /
-                       (tokens - 1))
-                st["intertoken"].observe(gap)
-                self._m_intertoken.labels(cohort=cohort).observe(gap)
-        else:
-            st["wasted_tokens"] += tokens
-        st["n"] += 1
+        gap = self._stats[cohort].observe(result)
+        if result.outcome == "completed" and result.ttft_s is not None:
+            self._m_ttft.labels(cohort=cohort).observe(result.ttft_s)
+        if gap is not None:
+            self._m_intertoken.labels(cohort=cohort).observe(gap)
         self._maybe_decide()
 
     # -- the decision ---------------------------------------------------
@@ -204,12 +275,8 @@ class CanaryController:
         self.canary_generation = int(generation)
         self.canary_replicas = frozenset(int(r) for r in cohort)
         self._began_ts = self._clock()
-        buckets = hvd_metrics.SERVE_PHASE_BUCKETS
-        self._stats = {
-            name: {"ttft": hvd_metrics.Histogram(buckets),
-                   "intertoken": hvd_metrics.Histogram(buckets),
-                   "goodput_tokens": 0, "wasted_tokens": 0, "n": 0}
-            for name in ("canary", "baseline")}
+        self._stats = {name: SLOWindow()
+                       for name in ("canary", "baseline")}
         self._m_fraction.set(self.pct)
         self._m_state.set(self.canary_generation)
         self._metrics.event(
@@ -217,46 +284,28 @@ class CanaryController:
             replicas=sorted(self.canary_replicas), pct=self.pct,
             window=self.window)
 
-    @staticmethod
-    def _p99(hist):
-        return hvd_metrics.histogram_quantile(hist.bounds, hist.counts,
-                                              0.99)
-
-    @staticmethod
-    def _goodput_ratio(st):
-        total = st["goodput_tokens"] + st["wasted_tokens"]
-        return st["goodput_tokens"] / total if total else 1.0
-
     def _maybe_decide(self):
         can, base = self._stats["canary"], self._stats["baseline"]
-        if can["n"] < self.window or base["n"] < self.window:
+        if can.n < self.window or base.n < self.window:
             return
         evidence = {
             "generation": self.canary_generation,
             "replicas": sorted(self.canary_replicas),
             "window": self.window,
-            "canary_n": can["n"], "baseline_n": base["n"],
-            "ttft_p99_canary": self._p99(can["ttft"]),
-            "ttft_p99_baseline": self._p99(base["ttft"]),
-            "intertoken_p99_canary": self._p99(can["intertoken"]),
-            "intertoken_p99_baseline": self._p99(base["intertoken"]),
-            "goodput_ratio_canary": round(self._goodput_ratio(can), 4),
-            "goodput_ratio_baseline": round(self._goodput_ratio(base), 4),
+            "canary_n": can.n, "baseline_n": base.n,
+            "ttft_p99_canary": can.ttft_p99(),
+            "ttft_p99_baseline": base.ttft_p99(),
+            "intertoken_p99_canary": can.intertoken_p99(),
+            "intertoken_p99_baseline": base.intertoken_p99(),
+            "goodput_ratio_canary": round(can.goodput_ratio(), 4),
+            "goodput_ratio_baseline": round(base.goodput_ratio(), 4),
             "ttft_x": self.ttft_x,
             "min_delta_s": self.min_delta_s,
             "goodput_drop": self.goodput_drop,
             "elapsed_s": round(self._clock() - self._began_ts, 3),
         }
-        breaches = []
-        for key in ("ttft", "intertoken"):
-            c = evidence[f"{key}_p99_canary"]
-            b = evidence[f"{key}_p99_baseline"]
-            if (c is not None and b is not None and
-                    c > self.ttft_x * b and c - b > self.min_delta_s):
-                breaches.append(f"{key}_p99")
-        if (evidence["goodput_ratio_canary"] <
-                evidence["goodput_ratio_baseline"] - self.goodput_drop):
-            breaches.append("goodput_ratio")
+        breaches = slo_breaches(can, base, self.ttft_x,
+                                self.min_delta_s, self.goodput_drop)
         if breaches:
             self._rollback(breaches, evidence)
         else:

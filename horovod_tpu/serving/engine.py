@@ -136,6 +136,11 @@ class ServeEngine:
         # is re-offered next step). None = swap whenever armed, the
         # pre-router behavior.
         self._swap_gate = swap_gate
+        # elasticity plane (docs/elasticity.md): a draining engine
+        # refuses new submissions but keeps admitting ITS OWN queue and
+        # stepping until the router retires it — planned scale-down
+        # finishes the work it already accepted, it never drops it
+        self._draining = False
         self._active = {}  # slot -> _Active
         self._finished = []
         reg = self._metrics = hvd_metrics.get_registry()
@@ -206,7 +211,26 @@ class ServeEngine:
     # -- submission -----------------------------------------------------
 
     def submit(self, request):
+        if self._draining:
+            return False
         return self.queue.submit(request)
+
+    # -- graceful drain (docs/elasticity.md) ----------------------------
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def begin_drain(self):
+        """Enter drain mode: no new admissions from outside, existing
+        queue + in-flight work runs to completion under the router's
+        drain deadline. Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        self._metrics.event("serve_drain_begin",
+                            inflight=len(self._active),
+                            queued=len(self.queue))
 
     # -- the step loop --------------------------------------------------
 
@@ -256,7 +280,7 @@ class ServeEngine:
                    for st in self._active.values())
         if hasattr(self.queue, "queued_work_tokens"):
             work += self.queue.queued_work_tokens()
-        return {
+        snap = {
             "queue_depth": len(self.queue),
             "active_slots": len(self._active),
             "work_tokens": work,
@@ -266,6 +290,9 @@ class ServeEngine:
             "armed_generation": (getattr(sub, "armed_generation", None)
                                  if sub is not None else None),
         }
+        if self._draining:
+            snap["draining"] = True
+        return snap
 
     # -- internals ------------------------------------------------------
 

@@ -85,7 +85,14 @@ class ReplicaGroup:
     def peer_loads(self):
         """The coordinator's per-replica load ledger ({rank: snapshot}),
         available on rank 0 (where the router runs); {} elsewhere or
-        before any replica has heartbeated a snapshot."""
+        before any replica has heartbeated a snapshot. Each snapshot
+        carries a coordinator-receipt ``ts`` (stamped when the
+        heartbeat landed, ops/negotiation.py) — the freshness the
+        router's ``HVD_ROUTE_STALE_S`` exclusion judges, so a replica
+        that stops heartbeating ages out of dispatch instead of
+        scoring as freshly idle forever. A draining engine's snapshot
+        additionally carries ``draining: True``
+        (ServeEngine.load_snapshot, docs/elasticity.md)."""
         service = self._worker.service
         if service is None:
             return {}

@@ -25,7 +25,11 @@ from horovod_tpu.common.config import HorovodConfig
 from horovod_tpu.common.exceptions import RanksLostError
 from horovod_tpu.ops import negotiation as neg
 from horovod_tpu.run import chaos, network
-from horovod_tpu.run.elastic import ElasticSupervisor
+from horovod_tpu.run.elastic import (DrainReplicaRequest,
+                                     ElasticSupervisor,
+                                     ReplicaSupervisorClient,
+                                     ReplicaSupervisorService,
+                                     SpawnReplicaRequest)
 from horovod_tpu.run.launch import run
 
 KEY = b"k" * 32
@@ -1756,3 +1760,581 @@ class TestDrillCanaryRollback:
         assert ("route_promote", 3) in calls, calls
         assert any("ROLLED BACK" in r for r in pm["reasons"]), \
             pm["reasons"]
+
+
+# ---------------------------------------------------------------------------
+# elasticity plane: the supervisor's spawn/drain control door under
+# injected transport faults (run/elastic.py ReplicaSupervisorService)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestReplicaSupervisorRPC:
+    SPEC = ReplicaSupervisorService.NAME
+
+    def _service(self):
+        calls = {"spawn": 0, "drain": []}
+
+        def on_spawn():
+            calls["spawn"] += 1
+            return 40 + calls["spawn"]
+
+        def on_drain(rid):
+            calls["drain"].append(rid)
+            return True
+
+        svc = ReplicaSupervisorService(KEY, on_spawn=on_spawn,
+                                       on_drain=on_drain)
+        return svc, calls
+
+    def test_dropped_response_retries_without_double_spawn(
+            self, monkeypatch):
+        """drop_response on the spawn ack: the supervisor DID spawn,
+        the ack died on the wire, the client's transport retry resends
+        the same change_id — and the ledger replays the recorded
+        response instead of starting a second replica."""
+        monkeypatch.setenv(
+            "HVD_CHAOS_SPEC",
+            f"{self.SPEC}:ReplicaOpResponse:drop_response:1.0:1")
+        monkeypatch.setenv("HVD_CHAOS_SEED", "3")
+        svc, calls = self._service()
+        try:
+            c = ReplicaSupervisorClient(_addr_map(svc.port), KEY)
+            c.backoff_base_s = 0.01
+            resp = c.spawn_replica("chg-1")
+            assert sum(svc._chaos.stats().values()) == 1  # fault fired
+            assert resp.ok and resp.replica_id == 41
+            assert resp.duplicate  # the retry was served from the ledger
+            assert calls["spawn"] == 1  # executed exactly once
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_duplicated_drain_is_idempotent(self, monkeypatch):
+        """Network-level duplicate delivery of a DrainReplicaRequest:
+        the handler runs twice, the drain hook runs once."""
+        monkeypatch.setenv(
+            "HVD_CHAOS_SPEC",
+            f"{self.SPEC}:DrainReplicaRequest:dup_request:1.0:1")
+        svc, calls = self._service()
+        try:
+            c = ReplicaSupervisorClient(_addr_map(svc.port), KEY)
+            resp = c.drain_replica("chg-2", 1)
+            assert sum(svc._chaos.stats().values()) == 1
+            assert resp.ok and resp.replica_id == 1
+            assert calls["drain"] == [1]  # once, not twice
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_delayed_drain_completes_within_bound(self, monkeypatch):
+        monkeypatch.setenv(
+            "HVD_CHAOS_SPEC",
+            f"{self.SPEC}:DrainReplicaRequest:delay_request:1.0:1")
+        monkeypatch.setenv("HVD_CHAOS_DELAY_MS", "200")
+        svc, calls = self._service()
+        try:
+            c = ReplicaSupervisorClient(_addr_map(svc.port), KEY)
+            t0 = time.monotonic()
+            resp = c.drain_replica("chg-3", 2)
+            elapsed = time.monotonic() - t0
+            assert resp.ok and calls["drain"] == [2]
+            assert 0.15 <= elapsed < 10.0  # delayed, not hung
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_distinct_change_ids_execute_separately(self):
+        svc, calls = self._service()
+        try:
+            c = ReplicaSupervisorClient(_addr_map(svc.port), KEY)
+            a = c.spawn_replica("chg-a")
+            b = c.spawn_replica("chg-b")
+            again = c.spawn_replica("chg-a")
+            assert (a.replica_id, b.replica_id) == (41, 42)
+            assert again.replica_id == 41 and again.duplicate
+            assert calls["spawn"] == 2
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_hook_exception_fails_loud_by_name(self):
+        def bad_spawn():
+            raise RuntimeError("no capacity on any host")
+
+        svc = ReplicaSupervisorService(KEY, on_spawn=bad_spawn)
+        try:
+            c = ReplicaSupervisorClient(_addr_map(svc.port), KEY)
+            resp = c.spawn_replica("chg-x")
+            assert not resp.ok
+            assert "no capacity" in resp.detail  # the NAMED failure
+            # the failure is ledgered too: a retry must not re-execute
+            # a spawn that already failed loudly
+            assert c.spawn_replica("chg-x").duplicate
+            c.close()
+        finally:
+            svc.shutdown()
+
+    def test_unconfigured_hooks_refuse(self):
+        svc = ReplicaSupervisorService(KEY)
+        try:
+            c = ReplicaSupervisorClient(_addr_map(svc.port), KEY)
+            assert not c.spawn_replica("c1").ok
+            assert not c.drain_replica("c2", 0).ok
+            c.close()
+        finally:
+            svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# elasticity plane drills: planned scale-down with in-flight work,
+# flap-storm convergence + graded rollback, and breaker isolation of a
+# wedged-but-heartbeating replica (router/elastic.py, docs/elasticity.md)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestDrillElasticity:
+    """Drills (l), the elasticity plane end to end on REAL serving
+    engines: the ElasticityController rides ``Router.step()`` exactly
+    as in production, engines run on a shared virtual clock (each
+    engine step bills 10ms), and every verdict must be replayable from
+    the flight dumps alone."""
+
+    class _Clock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    def _engine(self, clock, cfg, params, num_slots):
+        from horovod_tpu.serving.engine import ServeEngine
+        from horovod_tpu.serving.queue import AdmissionQueue
+
+        eng = ServeEngine(
+            cfg, params, num_slots=num_slots, max_len=64, kv_block=8,
+            queue=AdmissionQueue(max_depth=64, admission_timeout_s=1e9,
+                                 clock=clock),
+            clock=clock)
+
+        def timed_step(engine=eng, clk=clock):
+            clk.t += 0.010
+            return type(engine).step(engine)
+
+        eng.step = timed_step
+        return eng
+
+    def _postmortem(self, tmp_path, hvd_tracing, reason):
+        hvd_tracing.get_tracer().dump(reason=reason)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_postmortem
+        loaded, bad = hvd_postmortem.load_dumps(
+            hvd_postmortem.find_dumps(str(tmp_path)))
+        assert not bad
+        hvd_postmortem.rebase(loaded)
+        return hvd_postmortem.analyze(loaded)
+
+    def test_planned_scale_down_drains_clean_with_exact_parity(
+            self, tmp_path, monkeypatch):
+        """The planned scale-down drill: two replicas each hold an
+        in-flight decode when the operator lowers the floor; the
+        controller drains the victim gracefully — its in-flight work
+        finishes on it, nothing is killed, nothing is double-delivered
+        — grades the shrunk fleet like a canary, promotes, and the
+        postmortem names every transition from the dumps alone."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.router import Router
+        from horovod_tpu.router.elastic import ElasticityController
+        from horovod_tpu.serving.queue import Request
+        from horovod_tpu.utils import metrics as hvd_metrics
+        from horovod_tpu.utils import tracing as hvd_tracing
+
+        monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        hvd_metrics.reset(enabled=True)
+        hvd_tracing.reset(enabled=True, rank=0)
+        try:
+            clock = self._Clock()
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            engines = {rid: self._engine(clock, cfg, params, 4)
+                       for rid in (0, 1)}
+
+            def spawn(router):
+                rid = max(router._handles) + 1
+                return router.add_replica(
+                    rid, self._engine(clock, cfg, params, 4)).replica_id
+
+            # min_replicas=2 holds the floor through warm-up (idle is
+            # allowed to accumulate dwell, but the floor blocks action)
+            ctrl = ElasticityController(
+                spawn=spawn, min_replicas=2, dwell_s=0.2, cooldown_s=2.0,
+                window=6, ttft_x=1.5, min_delta_s=0.5, up_depth=100.0,
+                down_util=0.25, clock=clock)
+            router = Router(engines, policy="least_loaded",
+                            affinity_prefix=0, elastic=ctrl, shed_depth=0,
+                            drain_timeout_s=60.0, clock=clock)
+            submitted, results = [], []
+
+            def pump(n_new, tag, max_tokens=2, steps_cap=2000):
+                i, steps = 0, 0
+                while (i < n_new or router.pending()) and \
+                        steps < steps_cap:
+                    if i < n_new:
+                        rid = f"{tag}-{i}"
+                        assert router.submit(
+                            Request(rid, (3, 1, 4),
+                                    max_new_tokens=max_tokens))
+                        submitted.append(rid)
+                        i += 1
+                    results.extend(router.step())
+                    steps += 1
+
+            # phase 1: steady traffic fills the controller's baseline
+            pump(6, "warm")
+            assert ctrl.state == "steady"
+            assert router.live_replicas() == [0, 1]
+
+            # phase 2: one long decode IN FLIGHT on each replica — the
+            # work a graceless scale-down would kill
+            for i in range(2):
+                rid = f"hold-{i}"
+                assert router.submit(Request(rid, (3, 1, 4),
+                                             max_new_tokens=16))
+                submitted.append(rid)
+                results.extend(router.step())
+            assert sorted(set(router.inflight.values())) == [0, 1]
+
+            # phase 3: the operator lowers the floor; idle has already
+            # dwelled, so the next tick executes the planned scale-down
+            ctrl.min_replicas = 1
+            guard = 0
+            while ctrl.state == "steady" and guard < 200:
+                results.extend(router.step())
+                guard += 1
+            assert ctrl.state == "grading"
+            assert ctrl.transitions[-1]["action"] == "scale_down"
+            victim = ctrl.transitions[-1]["replica"]
+            assert victim in router._draining
+            # the victim was mid-decode when the drain began
+            assert any(r == victim for r in router.inflight.values())
+
+            # phase 4: the drain runs to completion — in-flight work
+            # retires ON the victim, which then leaves the fleet
+            guard = 0
+            while router._draining and guard < 1000:
+                results.extend(router.step())
+                guard += 1
+            assert not router._draining
+            assert router.live_replicas() == [1 - victim]
+            # the survivor's own long decode may still be running —
+            # only the VICTIM's work had to finish before retirement
+            guard = 0
+            while router.pending() and guard < 1000:
+                results.extend(router.step())
+                guard += 1
+            hold = {r.request_id: r for r in results
+                    if r.request_id.startswith("hold-")}
+            assert len(hold) == 2
+            assert all(r.outcome == "completed" for r in hold.values())
+            assert any(r.replica == victim for r in hold.values())
+
+            # phase 5: the after-window fills on the shrunk fleet and
+            # the change grades like a weight rollout: promote
+            pump(6, "post")
+            guard = 0
+            while ctrl.state == "grading" and guard < 100:
+                results.extend(router.step())
+                guard += 1
+            assert ctrl.state == "steady"
+            verdict, evidence = ctrl.decisions[-1]
+            assert verdict == "promote"
+            assert evidence["action"] == "scale_down"
+            assert evidence["breaches"] == []
+
+            # zero lost requests, exact submission/completion parity
+            assert len(results) == len(submitted)
+            outcomes = {r.request_id: r.outcome for r in results}
+            assert sorted(outcomes) == sorted(submitted)
+            assert all(o == "completed" for o in outcomes.values())
+
+            # the dumps alone name the transitions
+            pm = self._postmortem(tmp_path, hvd_tracing,
+                                  "elastic_scale_down_drill")
+            acts = [(t["action"], t.get("replica"))
+                    for t in pm["elastic_transitions"]]
+            assert ("scale_down", victim) in acts, acts
+            assert ("promote", victim) in acts, acts
+            drains = [(e.get("event"), e.get("replica"))
+                      for e in pm["drain_events"]]
+            assert ("route_drain_begin", victim) in drains, drains
+            assert ("route_drain_done", victim) in drains, drains
+            assert not any(e == "route_drain_timeout"
+                           for e, _ in drains), drains
+            assert any("drained clean" in r for r in pm["reasons"]), \
+                pm["reasons"]
+            assert any("scale_down" in r for r in pm["reasons"]), \
+                pm["reasons"]
+        finally:
+            hvd_metrics.reset()
+            hvd_tracing.reset()
+
+    def test_flap_storm_converges_and_bad_scale_down_rolls_back(
+            self, tmp_path, monkeypatch):
+        """The flap-storm drill: eight load oscillations faster than
+        the dwell produce ZERO topology changes; a genuine lull then
+        scales down — and when the next storm proves the shrunk fleet
+        breaches the TTFT SLO, the grade rolls the scale-down back by
+        re-spawning, after which the fleet converges and stays put."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.router import Router
+        from horovod_tpu.router.elastic import ElasticityController
+        from horovod_tpu.serving.queue import Request
+        from horovod_tpu.utils import metrics as hvd_metrics
+        from horovod_tpu.utils import tracing as hvd_tracing
+
+        monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        hvd_metrics.reset(enabled=True)
+        hvd_tracing.reset(enabled=True, rank=0)
+        try:
+            clock = self._Clock()
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            engines = {rid: self._engine(clock, cfg, params, 2)
+                       for rid in (0, 1)}
+            spawned = []
+
+            def spawn(router):
+                rid = max(router._handles) + 1
+                spawned.append(rid)
+                return router.add_replica(
+                    rid, self._engine(clock, cfg, params, 2)).replica_id
+
+            ctrl = ElasticityController(
+                spawn=spawn, min_replicas=1, dwell_s=0.3, cooldown_s=0.5,
+                window=6, ttft_x=1.5, min_delta_s=0.025, up_depth=100.0,
+                down_util=0.2, clock=clock)
+            router = Router(engines, policy="least_loaded",
+                            affinity_prefix=0, elastic=ctrl, shed_depth=0,
+                            drain_timeout_s=60.0, clock=clock)
+            submitted, results = [], []
+
+            def pump(n_new, tag, max_tokens=4, steps_cap=2000):
+                i, steps = 0, 0
+                while (i < n_new or router.pending()) and \
+                        steps < steps_cap:
+                    if i < n_new:
+                        rid = f"{tag}-{i}"
+                        assert router.submit(
+                            Request(rid, (3, 1, 4),
+                                    max_new_tokens=max_tokens))
+                        submitted.append(rid)
+                        i += 1
+                    results.extend(router.step())
+                    steps += 1
+
+            # phase 1, the flap storm: 8 oscillations, each lull far
+            # shorter than the dwell — hysteresis must absorb ALL of it
+            for cycle in range(8):
+                pump(4, f"flap{cycle}")
+                for _ in range(3):  # ~60ms lull << 300ms dwell
+                    results.extend(router.step())
+            assert ctrl.state == "steady"
+            assert ctrl.transitions == []  # not one flap leaked through
+            assert router.live_replicas() == [0, 1]
+
+            # phase 2, a real lull: idle holds past the dwell and the
+            # controller drains one replica
+            guard = 0
+            while ctrl.state == "steady" and guard < 200:
+                results.extend(router.step())
+                guard += 1
+            assert ctrl.state == "grading"
+            assert ctrl.transitions[-1]["action"] == "scale_down"
+            victim = ctrl.transitions[-1]["replica"]
+            guard = 0
+            while router._draining and guard < 200:
+                results.extend(router.step())
+                guard += 1
+            assert router.live_replicas() == [1 - victim]
+
+            # phase 3, the storm returns on the shrunk fleet: a 16-deep
+            # burst queues behind the survivor's two slots, the
+            # after-window breaches TTFT vs the flap-era baseline and
+            # the scale-down ROLLS BACK by re-spawning
+            for i in range(16):
+                rid = f"storm-{i}"
+                assert router.submit(Request(rid, (3, 1, 4),
+                                             max_new_tokens=8))
+                submitted.append(rid)
+            guard = 0
+            while ctrl.state == "grading" and guard < 500:
+                results.extend(router.step())
+                guard += 1
+            verdict, evidence = ctrl.decisions[-1]
+            assert verdict == "rollback", ctrl.decisions
+            assert "ttft_p99" in evidence["breaches"], evidence
+            assert evidence["ttft_p99_after"] > \
+                1.5 * evidence["ttft_p99_baseline"], evidence
+            assert spawned, "rollback must re-spawn what was drained"
+            assert len(router.live_replicas()) == 2
+
+            # phase 4, convergence: steady trickle, no further changes
+            changes = len(ctrl.transitions)
+            pump(12, "settle", max_tokens=2)
+            assert len(ctrl.transitions) == changes
+            assert ctrl.state == "steady"
+            assert len(router.live_replicas()) == 2
+
+            # zero lost requests across every phase of the storm
+            assert len(results) == len(submitted)
+            outcomes = {r.request_id: r.outcome for r in results}
+            assert sorted(outcomes) == sorted(submitted)
+            assert all(o == "completed" for o in outcomes.values())
+
+            # the dumps replay the whole storm
+            pm = self._postmortem(tmp_path, hvd_tracing,
+                                  "elastic_flap_drill")
+            acts = [t["action"] for t in pm["elastic_transitions"]]
+            assert acts.count("scale_down") == 1, acts
+            assert acts.count("rollback") == 1, acts
+            assert any("ROLLED BACK" in r for r in pm["reasons"]), \
+                pm["reasons"]
+        finally:
+            hvd_metrics.reset()
+            hvd_tracing.reset()
+
+    def test_breaker_isolates_wedged_but_heartbeating_replica(
+            self, tmp_path, monkeypatch):
+        """The sick-but-alive drill: a replica keeps serving fresh load
+        snapshots (its heartbeat is fine) but stops finishing work
+        mid-decode. The circuit breaker must trip on the wedged
+        in-flight age within its timeout bound, steer ALL new traffic
+        to the healthy replica while open, and close again once the
+        replica recovers — with every request eventually completing."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.router import Router
+        from horovod_tpu.router.elastic import CircuitBreaker
+        from horovod_tpu.serving.queue import Request
+        from horovod_tpu.utils import metrics as hvd_metrics
+        from horovod_tpu.utils import tracing as hvd_tracing
+
+        monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        hvd_metrics.reset(enabled=True)
+        hvd_tracing.reset(enabled=True, rank=0)
+        try:
+            clock = self._Clock()
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            engines = {rid: self._engine(clock, cfg, params, 2)
+                       for rid in (0, 1)}
+            breaker = CircuitBreaker(fails=3, probe_s=0.5, close_n=1,
+                                     timeout_s=1.0, clock=clock)
+            router = Router(engines, policy="least_loaded",
+                            affinity_prefix=0, breaker=breaker,
+                            shed_depth=0, clock=clock)
+            submitted, results = [], []
+
+            def feed(tag, n, max_tokens=2):
+                ids = set()
+                for i in range(n):
+                    rid = f"{tag}-{i}"
+                    assert router.submit(Request(rid, (3, 1, 4),
+                                                 max_new_tokens=max_tokens))
+                    submitted.append(rid)
+                    ids.add(rid)
+                    results.extend(router.step())
+                return ids
+
+            def drive(want, max_steps=600):
+                done = {r.request_id for r in results}
+                for _ in range(max_steps):
+                    if want <= done:
+                        return
+                    for r in router.step():
+                        results.append(r)
+                        done.add(r.request_id)
+                assert want <= done, f"never finished: {want - done}"
+
+            drive(feed("warm", 4))
+
+            # one long decode on each replica, then wedge the one
+            # serving hold-1: step() stops making progress while
+            # load_snapshot stays perfectly fresh (the router stamps
+            # fronted engines' snapshots 'now' — heartbeat looks fine)
+            feed("hold", 2, max_tokens=32)
+            wedged = router.inflight["hold-1"]
+            healthy = 1 - wedged
+            real_step = engines[wedged].step
+            engines[wedged].step = lambda: []
+            t_wedge = clock.t
+
+            guard = 0
+            while breaker.state(wedged) != "open" and guard < 500:
+                results.extend(router.step())
+                guard += 1
+            assert breaker.state(wedged) == "open"
+            # bounded isolation: the trip lands within the wedge
+            # timeout plus scheduler granularity
+            assert clock.t - t_wedge <= breaker.timeout_s + 0.25, \
+                (clock.t, t_wedge)
+            # ...while its heartbeat never went stale
+            assert router.loads()[wedged]["ts"] == clock.t
+
+            # while open, every new request lands on the healthy
+            # replica (probe timer hasn't fired yet)
+            before = len(results)
+            iso = feed("iso", 4)
+            drive(iso)
+            served = [r for r in results[before:]
+                      if r.request_id in iso]
+            assert len(served) == 4
+            assert all(r.replica == healthy for r in served), served
+
+            # recovery: the replica unwedges, its stuck decode retires,
+            # and that success closes the breaker (close_n=1)
+            engines[wedged].step = real_step
+            drive({"hold-0", "hold-1"})
+            assert breaker.state(wedged) == "closed"
+            # submit the batch before stepping: queue-depth feedback
+            # must spread it across BOTH replicas again
+            back = set()
+            for i in range(4):
+                rid = f"back-{i}"
+                assert router.submit(Request(rid, (3, 1, 4),
+                                             max_new_tokens=2))
+                submitted.append(rid)
+                back.add(rid)
+            drive(back)
+            assert any(r.replica == wedged for r in results
+                       if r.request_id in back)
+
+            # exact parity: the wedge delayed work, it lost none
+            assert len(results) == len(submitted)
+            outcomes = {r.request_id: r.outcome for r in results}
+            assert sorted(outcomes) == sorted(submitted)
+            assert all(o == "completed" for o in outcomes.values())
+
+            pm = self._postmortem(tmp_path, hvd_tracing,
+                                  "elastic_breaker_drill")
+            moves = [(e.get("replica"), e.get("state"), e.get("reason"))
+                     for e in pm["breaker_transitions"]]
+            assert (wedged, "open", "wedged") in moves, moves
+            assert (wedged, "closed", "recovered") in moves, moves
+            assert any("tripped open (wedged)" in r
+                       for r in pm["reasons"]), pm["reasons"]
+        finally:
+            hvd_metrics.reset()
+            hvd_tracing.reset()

@@ -1215,6 +1215,117 @@ def test_hvd017_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD018 — unbounded retry loop
+# ---------------------------------------------------------------------------
+
+def test_hvd018_triggers_on_deadline_free_sleep_loop(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=retry_path
+        import time
+
+        def wait_for_pointer(path):
+            while True:
+                if path.exists():
+                    return path.read_text()
+                time.sleep(0.1)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD018"]
+
+
+def test_hvd018_deadline_check_bounds_the_loop(tmp_path):
+    # the run/mpi.py rendezvous shape: monotonic-vs-deadline compare
+    # anywhere in the body is the bound this rule wants
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=retry_path
+        import time
+
+        def wait_for_pointer(path, timeout_s):
+            deadline = time.monotonic() + timeout_s
+            while True:
+                if path.exists():
+                    return path.read_text()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(path)
+                time.sleep(0.1)
+        """)
+    assert live(found) == []
+
+
+def test_hvd018_bound_named_operand_counts(tmp_path):
+    # a compare against a timeout/deadline-named value also reads as a
+    # bound even when the clock call is hoisted out of the compare
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=retry_path
+        import time
+
+        def poll(conn, timeout_s):
+            waited = 0.0
+            while True:
+                if conn.ready():
+                    return conn.take()
+                if waited >= timeout_s:
+                    raise TimeoutError
+                time.sleep(0.05)
+                waited += 0.05
+        """)
+    assert live(found) == []
+
+
+def test_hvd018_sleepless_drain_loop_not_flagged(tmp_path):
+    # a blocking-recv drain loop is bounded by its peer's EOF — no
+    # sleep, no finding (the serving queue's pop loop is this shape)
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=retry_path
+
+        def drain(sock):
+            while True:
+                msg = sock.recv()
+                if not msg:
+                    break
+        """)
+    assert live(found) == []
+
+
+def test_hvd018_scopes_to_control_planes(tmp_path):
+    # identical snippet with no role marker and no scoped dir is out
+    # of scope
+    found = lint_source(tmp_path, """\
+        import time
+
+        def wait(path):
+            while True:
+                time.sleep(0.1)
+        """)
+    assert live(found) == []
+    # ...and under horovod_tpu/router/ it fires without a marker
+    mod = tmp_path / "horovod_tpu" / "router"
+    mod.mkdir(parents=True)
+    f = mod / "spin.py"
+    f.write_text("import time\n\ndef wait(path):\n"
+                 "    while True:\n        time.sleep(0.1)\n")
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    findings, _ = analyze_paths([str(f)], env_registry_path=str(reg))
+    assert [f.rule for f in live(findings)] == ["HVD018"]
+
+
+def test_hvd018_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=retry_path
+        import time
+
+        def serve(sock):
+            # hvdlint: disable=HVD018(bounded by peer EOF; the sleep is an injected chaos fault)
+            while True:
+                req = sock.recv()
+                time.sleep(req.delay_s)
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD018"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -1274,7 +1385,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 18)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 19)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

@@ -453,8 +453,8 @@ class TestCanary:
         ctrl.tick(_armed_loads())
         for i in range(ctrl.window):
             ctrl.observe(_res(f"o{i}", 1), 1)  # old gen, canary replica
-        assert ctrl._stats["baseline"]["n"] == ctrl.window
-        assert ctrl._stats["canary"]["n"] == 0
+        assert ctrl._stats["baseline"].n == ctrl.window
+        assert ctrl._stats["canary"].n == 0
         assert ctrl.state == "canary"  # canary window still empty
 
     def test_quarantined_generation_never_recanaries(self, reg):

@@ -334,6 +334,73 @@ def analyze(dumps):
                         f"{e.get('canary_n')}+{e.get('baseline_n')} "
                         f"observations")
 
+    # 8. elasticity plane: every scale decision, drain edge, breaker
+    # transition and admission shed (docs/elasticity.md) — "why did the
+    # replica set change" and "why were requests rejected" must be
+    # answerable from the dumps alone, transition by transition.
+    elastic_transitions, drain_events, breaker_transitions = [], [], []
+    sheds = []
+    for d in dumps:
+        for e in d.get("events", []):
+            kind = e.get("event")
+            if kind in ("route_elastic_scale_up",
+                        "route_elastic_scale_down",
+                        "route_elastic_promote",
+                        "route_elastic_rollback"):
+                action = kind[len("route_elastic_"):]
+                # spread first: promote/rollback events carry the
+                # *graded* action inside the payload; the transition's
+                # own action comes from the event name
+                elastic_transitions.append(
+                    {**e, "dump_rank": _rank_of(d), "action": action})
+                if action in ("scale_up", "scale_down"):
+                    reasons.append(
+                        f"elastic: {action} change "
+                        f"{e.get('change_id')} (replica "
+                        f"{e.get('replica')}) on queue_depth="
+                        f"{e.get('queue_depth')} kv_starved="
+                        f"{e.get('kv_starved')} ttft_p99="
+                        f"{e.get('ttft_p99')}")
+                elif action == "rollback":
+                    reasons.append(
+                        f"elastic: change {e.get('change_id')} "
+                        f"({e.get('action')} of replica "
+                        f"{e.get('replica')}) ROLLED BACK on "
+                        f"{e.get('breaches')} — respawned "
+                        f"{e.get('respawned')}")
+                else:
+                    reasons.append(
+                        f"elastic: change {e.get('change_id')} "
+                        f"({e.get('action')}) promoted after "
+                        f"{e.get('after_n')} observations")
+            elif kind in ("route_drain_begin", "route_drain_done",
+                          "route_drain_timeout"):
+                drain_events.append(
+                    {"dump_rank": _rank_of(d), **e})
+                if kind == "route_drain_done":
+                    reasons.append(
+                        f"elastic: replica {e.get('replica')} drained "
+                        f"clean in {e.get('drained_s')}s (zero lost)")
+                elif kind == "route_drain_timeout":
+                    reasons.append(
+                        f"elastic: replica {e.get('replica')} drain "
+                        f"TIMED OUT after {e.get('drained_s')}s — "
+                        f"rerouted {e.get('rerouted')}")
+            elif kind == "route_breaker":
+                breaker_transitions.append(
+                    {"dump_rank": _rank_of(d), **e})
+                if e.get("state") == "open":
+                    reasons.append(
+                        f"breaker: replica {e.get('replica')} tripped "
+                        f"open ({e.get('reason')})")
+            elif kind == "route_shed":
+                sheds.append({"dump_rank": _rank_of(d), **e})
+    if sheds:
+        by_reason = collections.Counter(e.get("reason") for e in sheds)
+        reasons.append(
+            f"router: shed {len(sheds)} request(s) at admission "
+            f"({dict(by_reason)}) — every replica saturated")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -385,6 +452,10 @@ def analyze(dumps):
         "preemptions": preemptions,
         "reroutes": reroutes,
         "canary_decisions": canary_decisions,
+        "elastic_transitions": elastic_transitions,
+        "drain_events": drain_events,
+        "breaker_transitions": breaker_transitions,
+        "sheds": sheds,
     }
 
 
@@ -458,6 +529,23 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
                   e.get("breaches", [])) for e in
                  verdict["canary_decisions"]]
         lines.append(f"  canary verdicts: {calls}")
+    if verdict.get("elastic_transitions"):
+        steps = [(e.get("action"), e.get("change_id"),
+                  e.get("replica")) for e in
+                 verdict["elastic_transitions"]]
+        lines.append(f"  elastic changes: {steps}")
+    if verdict.get("drain_events"):
+        edges = [(e.get("event"), e.get("replica"),
+                  e.get("drained_s")) for e in verdict["drain_events"]]
+        lines.append(f"  drains         : {edges}")
+    if verdict.get("breaker_transitions"):
+        trips = [(e.get("replica"), e.get("state"), e.get("reason"))
+                 for e in verdict["breaker_transitions"]]
+        lines.append(f"  breaker moves  : {trips}")
+    if verdict.get("sheds"):
+        lines.append(f"  sheds          : {len(verdict['sheds'])} "
+                     f"(first retry-after "
+                     f"{verdict['sheds'][0].get('retry_after_s')}s)")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:

@@ -198,11 +198,21 @@ def analyze_serve(dumps, pct=None):
     from multiple replicas the tail is also rolled up per replica
     (``by_replica``); a replica owning the majority of the tail is
     named ``tail_replica`` in the verdict.
+
+    Admission sheds (``route_shed`` events in the dumps) are counted
+    too: a shed request never produces spans, so a span-only tail
+    reading under overload silently drops the worst-served requests —
+    the ones that got nothing at all. The verdict names them and their
+    reasons (docs/elasticity.md).
     """
     if pct is None:
         pct = float(os.environ.get("HVD_SLO_PCT", "90"))
     records = requests_from_dumps(dumps)
     records.sort(key=lambda r: r["total_ms"], reverse=True)
+    sheds = [e for d in dumps for e in d.get("events", [])
+             if e.get("event") == "route_shed"]
+    shed_reasons = dict(collections.Counter(
+        e.get("reason", "?") for e in sheds))
     out = {
         "requests": len(records),
         "pct": pct,
@@ -215,8 +225,15 @@ def analyze_serve(dumps, pct=None):
         "phase_mean_ms": {},
         "by_replica": {},
         "tail_replica": None,
+        "shed": len(sheds),
+        "shed_reasons": shed_reasons,
     }
     if not records:
+        if sheds:
+            out["verdict"] = (
+                f"no served requests in the dumps but {len(sheds)} "
+                f"shed at admission ({shed_reasons}) — the front door "
+                f"rejected everything it saw")
         return out
     n_tail = max(1, int(round(len(records) * (100.0 - pct) / 100.0)))
     tail = records[:n_tail]
@@ -252,6 +269,11 @@ def analyze_serve(dumps, pct=None):
             verdict += (f"; tail concentrated on replica {worst[0]} "
                         f"({worst[1]['tail_requests']}/{len(tail)} "
                         f"tail requests)")
+    if sheds:
+        # the admitted tail understates the pain: these requests were
+        # turned away before a single span existed
+        verdict += (f"; {len(sheds)} request(s) shed at admission "
+                    f"({shed_reasons}) — not counted in the phase tail")
     out["verdict"] = verdict
     return out
 
@@ -458,6 +480,24 @@ def selftest():
     assert "replica 1" in multi["verdict"], multi
     multi_report = render_report([], multi)
     assert "tail replica" in multi_report, multi_report
+
+    # shed-aware verdict: route_shed events in the dump count toward
+    # the overload story even though they left no spans behind
+    shed_dump = _synthetic_dump("prefill")
+    shed_dump.setdefault("events", []).extend(
+        {"event": "route_shed", "request_id": f"shed-{i}",
+         "reason": "queue_depth", "retry_after_s": 4.0}
+        for i in range(5))
+    shed = analyze_serve([shed_dump])
+    assert shed["shed"] == 5, shed
+    assert shed["shed_reasons"] == {"queue_depth": 5}, shed
+    assert "5 request(s) shed at admission" in shed["verdict"], shed
+    empty = {"rank": 0, "spans": [], "open_spans": [],
+             "events": [{"event": "route_shed", "request_id": "s",
+                         "reason": "kv_exhausted", "retry_after_s": 2.0}]}
+    all_shed = analyze_serve([empty])
+    assert all_shed["requests"] == 0 and all_shed["shed"] == 1, all_shed
+    assert "rejected everything" in all_shed["verdict"], all_shed
 
     # the report and the trace must render without error
     dumps = [_synthetic_dump("queue_wait")]

@@ -564,6 +564,44 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
                                  f"p50 {_fmt_s(cp50):>8}   "
                                  f"p99 {_fmt_s(cp99):>8}")
 
+    # elasticity plane: scale changes, drains, admission sheds and
+    # per-replica breaker state (horovod_tpu/router/elastic.py;
+    # docs/elasticity.md)
+    changes = _by_label(snap, "hvd_elastic_changes_total", "action")
+    sheds = _by_label(snap, "hvd_route_shed_total", "reason")
+    breaker = _by_label(snap, "hvd_route_breaker_state", "replica")
+    if changes or sheds or breaker:
+        lines.append(c(BOLD, "  elasticity"))
+        pressure = _total(snap, "hvd_elastic_pressure")
+        p_word = {1: "SCALE-UP", -1: "idle", 0: "in band"}.get(
+            int(pressure), "in band")
+        draining = _total(snap, "hvd_route_replicas_draining")
+        ch_s = "  ".join(f"{k}={int(v):,}"
+                         for k, v in sorted(changes.items())) or "-"
+        e_line = (f"    changes       {ch_s}   pressure {p_word}   "
+                  f"draining {int(draining):,}")
+        lines.append(c(YELLOW, e_line)
+                     if changes.get("rollback") or draining else e_line)
+        if sheds:
+            shed_rate = _rate(snap, prev, "hvd_route_shed_total", dt)
+            shed_s = "  ".join(f"{k}={int(v):,}"
+                               for k, v in sorted(sheds.items()))
+            lines.append(c(RED, f"    SHEDDING      {shed_s}   "
+                               f"{_fmt_rate(shed_rate, '/s')} — every "
+                               f"dispatchable replica saturated"))
+        open_reps = sorted(r for r, v in breaker.items() if v >= 2)
+        half = sorted(r for r, v in breaker.items() if v == 1)
+        if open_reps or half:
+            trips = _by_label(snap, "hvd_route_breaker_trips_total",
+                              "reason")
+            trip_s = "  ".join(f"{k}={int(v):,}"
+                               for k, v in sorted(trips.items()))
+            lines.append(c(RED, f"    breakers      open {open_reps}   "
+                               f"half-open {half}   trips {trip_s or '-'}"))
+        elif breaker:
+            lines.append(f"    breakers      all closed "
+                         f"({len(breaker)} replica(s))")
+
     # tracing plane: per-stage span latency + the slow-span tail
     span_entry = snap.get("metrics", {}).get("hvd_span_seconds")
     slow = [e for e in snap.get("events", [])
@@ -606,10 +644,14 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
             code = RED if kind in ("ranks_lost", "stall_kill",
                                    "numerics_anomaly", "serve_failover",
                                    "route_rollback",
-                                   "route_replica_lost") else (
+                                   "route_replica_lost",
+                                   "route_elastic_rollback",
+                                   "route_drain_timeout") else (
                 YELLOW if kind in ("stall", "chaos_injection",
-                                   "serve_reject",
-                                   "route_reroute") else DIM)
+                                   "serve_reject", "route_reroute",
+                                   "route_shed", "route_breaker",
+                                   "route_elastic_scale_up",
+                                   "route_elastic_scale_down") else DIM)
             detail = {k: v for k, v in ev.items()
                       if k not in ("event", "ts_us", "epoch_us")}
             lines.append(c(code, f"    [{ev.get('ts_us', 0) / 1e6:>9.3f}s] "
@@ -801,6 +843,26 @@ def canned_snapshot():
         ct.labels(cohort="baseline").observe(0.03)
     for _ in range(5):
         ct.labels(cohort="canary").observe(0.04)
+    ec = reg.counter("hvd_elastic_changes_total", "c",
+                     labels=("action",))
+    ec.labels(action="scale_up").inc(2)
+    ec.labels(action="scale_down").inc(1)
+    ec.labels(action="rollback").inc(1)
+    reg.gauge("hvd_elastic_pressure", "g").set(1)
+    reg.gauge("hvd_route_replicas_draining", "g").set(1)
+    reg.counter("hvd_route_shed_total", "c",
+                labels=("reason",)).labels(reason="queue_depth").inc(7)
+    bs = reg.gauge("hvd_route_breaker_state", "g", labels=("replica",))
+    bs.labels(replica="0").set(0)
+    bs.labels(replica="1").set(2)
+    reg.counter("hvd_route_breaker_trips_total", "c",
+                labels=("reason",)).labels(reason="wedged").inc(1)
+    reg.event("route_shed", request_id="req-9920", reason="queue_depth",
+              retry_after_s=4.0)
+    reg.event("route_elastic_scale_up", change_id=3, replica=2,
+              queue_depth=9, kv_starved=False, ttft_p99=1.42)
+    reg.event("route_breaker", replica=1, state="open", reason="wedged",
+              age_s=12.0)
     reg.event("route_reroute", request_id="req-9810", from_replica=1,
               to_replica=0, attempt=1, waited_s=0.42)
     reg.event("slow_span", stage="negotiate", tensor="grad/dense_7",

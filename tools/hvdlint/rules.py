@@ -1317,6 +1317,93 @@ def check_direct_engine_submit(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD018 — unbounded retry loop
+# ---------------------------------------------------------------------------
+
+# control/serving planes where a silent spin must instead become a
+# loud, bounded-time error; fixtures opt in with
+# `# hvdlint: role=retry_path`
+_RETRY_DIRS = ("horovod_tpu/router/", "horovod_tpu/serving/",
+               "horovod_tpu/fleet/", "horovod_tpu/run/")
+# call names that make a while-True loop a *waiting* loop (the shape
+# this rule cares about) rather than a worker drain loop
+_WAIT_CALLEES = {"sleep", "wait"}
+# clock calls whose presence in a comparison reads as a deadline check
+_CLOCK_CALLEES = {"monotonic", "time", "perf_counter"}
+# operand names that read as a time bound
+_BOUND_NAME = re.compile(
+    r"deadline|timeout|time_out|budget|until|expires|expiry|give_up",
+    re.IGNORECASE)
+
+
+def _is_constant_true(test):
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _names_in(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _has_time_bound(loop):
+    """True if the loop body contains something that reads as a
+    deadline/timeout check: a comparison whose operands call a clock
+    or name a bound (deadline/timeout/budget/until/...), or a
+    ``something_deadline.check()``-style call."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Compare):
+            for name in _names_in(node):
+                if name in _CLOCK_CALLEES or _BOUND_NAME.search(name):
+                    return True
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if (chain is not None and len(chain) >= 2 and
+                    chain[-1] in ("check", "remaining", "expired") and
+                    _BOUND_NAME.search(chain[-2])):
+                return True
+    return False
+
+
+def check_unbounded_retry_loop(ctx, shared):
+    if "retry_path" not in ctx.roles and not any(
+            d in ctx.relpath for d in _RETRY_DIRS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        if not _is_constant_true(node.test):
+            continue
+        sleeps = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            chain = _attr_chain(sub.func)
+            callee = (chain[-1] if chain else
+                      sub.func.id if isinstance(sub.func, ast.Name)
+                      else None)
+            if callee in _WAIT_CALLEES:
+                sleeps = True
+                break
+        if not sleeps:
+            continue  # a drain/dispatch loop, not a waiting loop
+        if _has_time_bound(node):
+            continue
+        yield Finding(
+            "HVD018", ctx.relpath, node.lineno, node.col_offset,
+            "unbounded retry loop: `while True` + sleep with no "
+            "deadline or timeout check anywhere in the body. On the "
+            "control and serving planes a condition that never "
+            "arrives must become a LOUD bounded-time error, never a "
+            "silent spin — this loop waits forever instead. Add a "
+            "deadline (`if time.monotonic() > deadline: raise ...`) "
+            "or a bounded attempt budget, or carry a disable/baseline "
+            "reason naming the external event that bounds the loop.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1827,5 +1914,45 @@ Fix: front the engines with a ``Router`` (it accepts one replica
 fine) and submit through it; keep a direct call only with a reason
 naming why a bare single engine is the point.""",
             check_direct_engine_submit),
+        Rule(
+            "HVD018", "unbounded-retry-loop",
+            "while-True + sleep with no deadline in the control/"
+            "serving planes",
+            """HVD018 — unbounded retry loop
+
+The repo's liveness discipline (docs/chaos.md): a peer that goes
+silent must become a LOUD, bounded-time error — RanksLostError after
+``rank_lost_timeout_s``, a drain past ``HVD_ELASTIC_DRAIN_TIMEOUT_S``
+force-retires and reroutes, BasicClient gives up after ``attempts``.
+Every waiting path owns a clock.
+
+A ``while True: ... sleep(...)`` loop with no deadline check is the
+opposite: when the condition it polls for never arrives (coordinator
+died, file never appears, replica wedged mid-request), the process
+waits FOREVER with no event, no metric, no error — the silent hang
+the chaos drills exist to make impossible. The historical shape: a
+rendezvous poll written for the happy path, discovered the first time
+a 256-host job sat overnight on one missing peer.
+
+Flags ``ast.While`` with a constant-true test whose body both calls a
+``sleep``/``wait`` and contains nothing that reads as a time bound —
+no comparison touching a clock call (time.monotonic / time.time /
+perf_counter) or a deadline/timeout/budget/until-named operand, and
+no ``deadline.check()``-style call. Loops without a sleep are NOT
+flagged (a blocking-recv drain loop is bounded by its peer's EOF, and
+pure dispatch loops are the serving plane's normal shape). Scope:
+``horovod_tpu/router/``, ``horovod_tpu/serving/``,
+``horovod_tpu/fleet/``, ``horovod_tpu/run/`` (fixtures opt in with
+``# hvdlint: role=retry_path``).
+
+The baselined site is run/network.py's handler loop: its only sleep
+is an injected chaos ``delay_request``/``delay_response`` fault, and
+the loop itself is bounded by the peer closing the connection
+(``_wire.read`` raises EOF), not by a clock.
+
+Fix: compute ``deadline = time.monotonic() + timeout_s`` before the
+loop and raise past it (run/mpi.py's rendezvous poll is the model),
+or bound attempts and surface the give-up as an event/exception.""",
+            check_unbounded_retry_loop),
     ]
 }
