@@ -1435,6 +1435,139 @@ def _bench_elastic(on_tpu):
     return out
 
 
+def _bench_mesh(on_tpu):
+    """Named-mesh data plane leg (docs/mesh.md); HVD_BENCH_MESH=0 skips.
+
+    Train arm: the SAME LM step at the SAME global batch, once dp-only
+    and once dp×tp=2, both through the promoted spec-tree path
+    (trainer.make_gspmd_step + models.transformer.param_specs over
+    parallel/mesh.py shardings). tokens/s/chip for both arms rides the
+    bench JSON; the throughput ratio is report-only on CPU (virtual
+    chips share host cores, so tp's collective price is meaningless
+    there) and ENFORCED on TPU: tp=2 must hold >=50% of the dp-only
+    per-chip rate at this comm-light shape — a collapse means sharding
+    propagation broke and GSPMD is gathering full weights every step.
+    One-step loss parity vs dp-only is asserted on EVERY platform
+    (rtol 5e-4, the MULTICHIP contract).
+
+    Serve arm: a tp=2 ServeEngine over the same mesh must (a) serve
+    temp-0 decode token-for-token equal to the unsharded engine and
+    (b) hold per-chip KV-cache bytes >=1.9x below it
+    (KVCache.per_chip_bytes) — the memory win that lets one replica
+    front a model bigger than a chip. Enforced everywhere: it is a
+    placement fact, not a throughput number."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu import trainer
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.parallel import mesh as mesh_lib
+
+    n = jax.device_count()
+    if n < 2 or n % 2:
+        return {"skipped": f"needs an even device count >=2, have {n}"}
+
+    cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                    attention_impl="full")
+    model, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    loss_fn = tr.lm_loss_fn(model)
+    specs = tr.param_specs(params)
+    batch, seq = max(2 * n, 8), 64  # equal global batch in both arms
+    steps = 8 if on_tpu else 4
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    def train_arm(mesh):
+        tx = optax.adam(1e-3)
+        p = trainer.place(params, mesh, specs)
+        opt = trainer.init_opt_state(tx, p, mesh, specs)
+        step, _, batch_sharding = trainer.make_gspmd_step(
+            loss_fn, tx, mesh, specs, tr.batch_spec(), donate=False,
+            params=p)
+        data = jax.device_put(toks, batch_sharding)
+        p, opt, loss = step(p, opt, data)  # compile + warmup
+        first_loss = float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, opt, loss = step(p, opt, data)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        return first_loss, batch * seq * steps / dt / n
+
+    devices = jax.devices()
+    dp_loss, dp_tps = train_arm(mesh_lib.build_mesh(devices=devices))
+    tp_loss, tp_tps = train_arm(
+        mesh_lib.build_mesh(tp=2, devices=devices))
+    ratio = tp_tps / max(dp_tps, 1e-9)
+    out = {
+        "devices": n,
+        "global_batch": batch,
+        "seq": seq,
+        "steps": steps,
+        "dp_tokens_per_sec_per_chip": round(dp_tps, 1),
+        "tp2_tokens_per_sec_per_chip": round(tp_tps, 1),
+        "tp2_vs_dp_ratio": round(ratio, 3),
+        "ratio_enforced": bool(on_tpu),
+    }
+    assert abs(tp_loss - dp_loss) <= 5e-4 * max(1.0, abs(dp_loss)), (
+        f"tp=2 first-step loss {tp_loss:.6f} diverges from dp-only "
+        f"{dp_loss:.6f} past the MULTICHIP rtol: {out}")
+    if on_tpu:
+        assert ratio >= 0.5, (
+            f"tp=2 per-chip rate collapsed to {ratio:.2f}x of dp-only "
+            f"— sharding propagation is gathering full weights: {out}")
+
+    # -- serve arm: tp-sharded decode over the same mesh ---------------
+    from horovod_tpu.serving.engine import ServeEngine
+    from horovod_tpu.serving.queue import AdmissionQueue, Request
+
+    def serve_arm(mesh):
+        eng = ServeEngine(
+            cfg, params, num_slots=2, max_len=48, kv_block=8,
+            queue=AdmissionQueue(max_depth=64, admission_timeout_s=1e9),
+            mesh=mesh)
+        for i, prompt in enumerate([(5, 9, 17),
+                                    (4, 8, 15, 16, 23, 42)]):
+            eng.submit(Request(f"r{i}", prompt, max_new_tokens=8,
+                               temperature=0.0))
+        res = {r.request_id: list(r.tokens)
+               for r in eng.run_to_completion()}
+        return [res[f"r{i}"] for i in range(2)], eng
+
+    ref_tokens, ref_eng = serve_arm(None)
+    mesh = mesh_lib.build_mesh(tp=2, devices=devices)
+    # commit for the decode head-sharding hint; restore whatever the
+    # process had committed before (bench shares one interpreter)
+    prior = mesh_lib.global_mesh_if_set()
+    mesh_lib.reset_global_mesh()
+    mesh_lib.set_global_mesh(mesh)
+    try:
+        tp_tokens, tp_eng = serve_arm(mesh)
+    finally:
+        mesh_lib.reset_global_mesh()
+        if prior is not None:
+            mesh_lib.set_global_mesh(prior)
+
+    kv_ratio = (ref_eng.kv.per_chip_bytes()
+                / max(tp_eng.kv.per_chip_bytes(), 1))
+    out["serve"] = {
+        "kv_per_chip_bytes_dp": ref_eng.kv.per_chip_bytes(),
+        "kv_per_chip_bytes_tp2": tp_eng.kv.per_chip_bytes(),
+        "kv_per_chip_bytes_ratio": round(kv_ratio, 3),
+        "temp0_tokens_equal": tp_tokens == ref_tokens,
+    }
+    assert tp_tokens == ref_tokens, (
+        f"tp=2 engine decoded different temp-0 tokens than the "
+        f"unsharded engine: {out['serve']}")
+    assert kv_ratio >= 1.9, (
+        f"per-chip KV bytes dropped only {kv_ratio:.2f}x at tp=2 "
+        f"(>=1.9x required): {out['serve']}")
+    return out
+
+
 def _bench_profile(window, meta):
     """Per-op profile decomposition of one flagship transformer window:
     account for every millisecond of the step — flash kernels, matmuls,
@@ -1746,6 +1879,14 @@ def main():
     elastic = None
     if os.environ.get("HVD_BENCH_ELASTIC", "") != "0":
         elastic = _bench_elastic(on_tpu)
+    # Named-mesh data plane leg: dp-only vs dp×tp=2 LM step at equal
+    # global batch (tokens/s/chip; ratio enforced on TPU only) plus the
+    # tp-sharded serve arm (temp-0 parity + per-chip KV bytes >=1.9x
+    # below unsharded, ENFORCED everywhere). HVD_BENCH_MESH=0 skips it;
+    # it skips itself on hosts without an even device count >=2.
+    mesh_leg = None
+    if os.environ.get("HVD_BENCH_MESH", "") != "0":
+        mesh_leg = _bench_mesh(on_tpu)
     # Checkpoint-plane overhead gate: async double-buffered saves every
     # step vs no checkpointing around a calibrated training-shaped
     # step; the <=2% budget is ENFORCED (AssertionError), the
@@ -1931,6 +2072,7 @@ def main():
         "swap": swap,
         "route": route,
         "elastic": elastic,
+        "mesh": mesh_leg,
         "ckpt": ckpt,
         "perf_attrib": perf_attrib,
         "metrics": metrics_snap,

@@ -102,6 +102,16 @@ echo "--- checkpoint plane (fast fail: commit protocol, torture matrix, reshard)
 # drills ride test_chaos_plane.py with the other drills.
 python -m pytest tests/test_checkpoint.py -q -m "not slow"
 
+echo "--- mesh plane (fast fail: spec parsing, global-mesh lifecycle, spec-tree placement, cross-layout restore)"
+# The named-mesh data plane (docs/mesh.md) is the placement contract
+# everything else stands on: one process-global dp×tp×sp mesh, spec
+# trees resolving to NamedShardings through parallel/mesh.py alone
+# (hvdlint HVD019), checkpoints that restore bit-exact across layouts.
+# The fast leg is the units + the 8-device virtual-mesh smoke; the
+# dp×tp×sp training-parity and tp-serving arms are @slow and ride the
+# full suite below.
+python -m pytest tests/test_mesh_plane.py -q -m "not slow"
+
 echo "--- fleet plane (fast fail: publication pointer, hot-swap parity, refusal)"
 # The fleet plane (docs/fleet.md) is the train->serve weight path:
 # every checkpoint commit becomes a published generation, replicas
@@ -167,6 +177,15 @@ echo "--- driver contract: env-free multi-chip dryrun"
 # on a 1-chip host); dryrun_multichip self-provisions the virtual mesh.
 env -u XLA_FLAGS -u JAX_PLATFORMS \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "--- MULTICHIP gate: promoted data plane vs dryrun mesh path"
+# The promoted global-mesh data plane (HOROVOD_MESH -> set_global_mesh
+# -> trainer helpers with mesh=None) must match dryrun_multichip's
+# ad-hoc build_mesh path on their shared dp×tp×sp config to the
+# MULTICHIP tolerance — a divergence means the promotion changed
+# numerics, not just plumbing (docs/mesh.md).
+env -u XLA_FLAGS -u JAX_PLATFORMS \
+    python -c "import __graft_entry__ as g; g.dryrun_mesh_parity(8)"
 
 echo "--- example smoke tests"
 make examples

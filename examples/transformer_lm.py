@@ -99,11 +99,28 @@ def main():
     args = parse_args()
     hvd.init()
     n = hvd.size()
-    dp = args.dp or n // (args.tp * args.sp * args.ep)
-    if dp * args.tp * args.sp * args.ep != n:
-        raise SystemExit(f"dp*tp*sp*ep = {dp}*{args.tp}*{args.sp}*{args.ep} "
-                         f"!= {n} devices")
-    mesh = mesh_mod.build_mesh(dp=dp, tp=args.tp, sp=args.sp, ep=args.ep)
+    # The named-mesh data plane (docs/mesh.md): CLI flags win when given;
+    # otherwise the HOROVOD_MESH / HOROVOD_MESH_TP / HOROVOD_MESH_SP env
+    # knobs configure the layout, and with nothing set this is the same
+    # pure-dp mesh as always. The result is committed as THE process
+    # mesh — trainer/checkpoint/serving helpers all place through it.
+    cli = (args.dp is not None or args.tp != 1 or args.sp != 1 or
+           args.ep != 1)
+    if cli:
+        dp = args.dp or n // (args.tp * args.sp * args.ep)
+        if dp * args.tp * args.sp * args.ep != n:
+            raise SystemExit(
+                f"dp*tp*sp*ep = {dp}*{args.tp}*{args.sp}*{args.ep} "
+                f"!= {n} devices")
+        mesh = mesh_mod.build_mesh(dp=dp, tp=args.tp, sp=args.sp,
+                                   ep=args.ep)
+    else:
+        mesh = mesh_mod.mesh_from_env()
+    mesh_mod.set_global_mesh(mesh)
+    dp = mesh_mod.mesh_axis_size(mesh, "dp")
+    tp = mesh_mod.mesh_axis_size(mesh, "tp")
+    sp = mesh_mod.mesh_axis_size(mesh, "sp")
+    ep = mesh_mod.mesh_axis_size(mesh, "ep")
     verbose = hvd.process_rank() == 0
 
     cfg = SIZES[args.size](attention_impl=args.attention, remat=args.remat,
@@ -112,7 +129,7 @@ def main():
     seq = args.seq_len or min(cfg.max_seq_len, 256)
     batch = args.batch_size * dp
     if verbose:
-        print(f"mesh dp={dp} tp={args.tp} sp={args.sp} "
+        print(f"mesh dp={dp} tp={tp} sp={sp} "
               f"model={args.size} seq={seq} attention={args.attention}")
 
     model = tr.TransformerLM(cfg)
@@ -130,8 +147,9 @@ def main():
         0.0, args.lr, args.warmup_steps, max(args.steps, 2 * args.warmup_steps))
     tx = optax.adamw(sched, weight_decay=0.01)
 
+    specs = None
     if args.eager_allreduce:
-        if args.tp * args.sp * args.ep != 1:
+        if tp * sp * ep != 1:
             raise SystemExit("--eager-allreduce is pure data-parallel: "
                              "tp/sp/ep must all be 1")
         from bench_common import build_eager_lm_step
@@ -144,10 +162,11 @@ def main():
         loss_fn = tr.lm_loss_fn(model, vocab_chunk=args.vocab_chunk)
         specs = tr.param_specs(params)
         step, param_shardings, batch_sharding = trainer.make_gspmd_step(
-            loss_fn, tx, mesh, specs, tr.batch_spec(sp=args.sp > 1),
+            loss_fn, tx, mesh, specs, tr.batch_spec(sp=sp > 1),
             params=params)
-        params = jax.tree_util.tree_map(jax.device_put, params,
-                                        param_shardings)
+        # tree-wide placement through the sanctioned helper (HVD019):
+        # one batched transfer, every leaf pinned by its spec
+        params = trainer.place(params, mesh, specs)
         opt_state = trainer.init_opt_state(tx, params, mesh, specs)
 
     # Checkpoint plane (docs/checkpoint.md): async saves every
@@ -164,9 +183,16 @@ def main():
             ckptr = trainer.Checkpointer(
                 args.checkpoint_dir, every=args.checkpoint_every,
                 preemption=jax.process_index() == 0,
-                rank=jax.process_index(), verbose=verbose)
+                rank=jax.process_index(), verbose=verbose,
+                layout=mesh_mod.mesh_layout(mesh))
+            # cross-layout resume (docs/mesh.md): the checkpoint may have
+            # been saved under a different dp×tp×sp factorization — the
+            # spec tree re-places every leaf on THIS run's mesh
+            resume_specs = (specs,
+                            trainer.opt_state_specs(tx, params, specs))
             (params, opt_state), start_step, _extra = ckptr.resume(
-                like=(params, opt_state))
+                like=(params, opt_state), mesh=mesh,
+                spec_tree=resume_specs)
         elif verbose:
             print("checkpointing disabled: params span non-addressable "
                   "devices (multi-host sharded); gather or use "
@@ -189,11 +215,28 @@ def main():
     params, opt_state, loss = step(params, opt_state, batch_tokens())
     float(loss)
 
+    # Per-axis wire attribution (docs/metrics.md): analytic payload bytes
+    # of the step's collectives, split by mesh axis — the dp leg is the
+    # gradient allreduce (every param), the tp leg the Megatron
+    # activation allreduces (2 fwd + 2 bwd per layer of one dp-shard's
+    # [B/dp, S, D] residual). GSPMD hides the executed collectives inside
+    # the compiled step, so the counters carry the model, not a probe.
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    dp_step_bytes = sum(x.size * np.dtype(x.dtype).itemsize
+                        for x in jax.tree_util.tree_leaves(params)) \
+        if dp > 1 else 0
+    tp_step_bytes = (4 * cfg.num_layers * (batch // dp) * seq *
+                     cfg.d_model * itemsize) if tp > 1 else 0
+
     t0 = time.perf_counter()
     tokens_done = 0
     for i in range(start_step, args.steps):
         params, opt_state, loss = step(params, opt_state, batch_tokens())
         tokens_done += batch * seq
+        if dp_step_bytes:
+            mesh_mod.account_axis_bytes("dp", dp_step_bytes)
+        if tp_step_bytes:
+            mesh_mod.account_axis_bytes("tp", tp_step_bytes)
         if not args.bench and verbose and (i + 1) % 10 == 0:
             print(f"step {i + 1}: loss={float(loss):.4f}")
         if ckptr is not None and ckptr.step_end(
@@ -216,15 +259,15 @@ def main():
         print(f"final loss {float(loss):.4f}")
         print(f"{tps:,.0f} tokens/sec total ({tps / n:,.0f}/chip, "
               f"{ms:.1f} ms/step)")
-        if args.bench and args.sp > 1:
+        if args.bench and sp > 1:
             # ring/Ulysses sequence parallelism: per-chip residency and
             # wire volume scale with seq/sp, so the measured single-chip
             # envelope (docs/benchmarks.md) projects to sp x that length
             # on a ring of sp chips
             h = cfg.num_heads
             hd = cfg.d_model // h
-            blk = (batch // dp) * (seq // args.sp) * h * hd * 2  # bf16
-            print(f"sp={args.sp}: seq/chip {seq // args.sp} of {seq} "
+            blk = (batch // dp) * (seq // sp) * h * hd * 2  # bf16
+            print(f"sp={sp}: seq/chip {seq // sp} of {seq} "
                   f"global; ring hop payload {2 * blk / 2 ** 20:.1f} MiB "
                   f"(K+V); projected envelope ≈ sp x single-chip "
                   f"(same per-chip residency)")

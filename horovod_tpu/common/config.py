@@ -343,6 +343,22 @@ ENV_REGISTRY = (
      "Framework log level (TRACE/DEBUG/INFO/WARNING/ERROR/FATAL)."),
     ("HOROVOD_LOG_TIMESTAMP", True, "0", "common/config.py",
      "Prefix log lines with timestamps."),
+    ("HOROVOD_MESH", False, None, "parallel/mesh.py",
+     "Full data-plane mesh spec as comma-separated axis=size pairs "
+     "(e.g. dp=2,tp=4; dp may be omitted and absorbs the remaining "
+     "devices). Wins over the per-axis HOROVOD_MESH_* knobs."),
+    ("HOROVOD_MESH_EP", False, "1", "parallel/mesh.py",
+     "Expert-parallel axis size for the global mesh (ignored when "
+     "HOROVOD_MESH is set)."),
+    ("HOROVOD_MESH_PP", False, "1", "parallel/mesh.py",
+     "Pipeline-parallel axis size for the global mesh (ignored when "
+     "HOROVOD_MESH is set)."),
+    ("HOROVOD_MESH_SP", False, "1", "parallel/mesh.py",
+     "Sequence-parallel axis size for the global mesh (ignored when "
+     "HOROVOD_MESH is set)."),
+    ("HOROVOD_MESH_TP", False, "1", "parallel/mesh.py",
+     "Tensor-parallel axis size for the global mesh (ignored when "
+     "HOROVOD_MESH is set)."),
     ("HOROVOD_METRICS", True, "1", "utils/metrics.py",
      "Set 0 to replace the metrics registry with no-op instruments."),
     ("HOROVOD_METRICS_EVENT_LOG", True, None, "utils/metrics.py",
@@ -556,6 +572,10 @@ ENV_REGISTRY = (
     ("HVD_BENCH_LABEL", False, None, "bench.py",
      "Free-form run label stamped into the bench JSON provenance "
      "(shows up as the run name in tools/hvd_perf.py reports)."),
+    ("HVD_BENCH_MESH", False, None, "bench.py",
+     "Set 0 to skip the named-mesh bench leg (tp=2 vs dp-only eager "
+     "LM tokens/s/chip at equal global batch, plus the tp-sharded "
+     "serve decode arm asserting per-chip KV bytes drop >=1.9x)."),
     ("HVD_BENCH_PERF", False, None, "bench.py",
      "Set 0 to skip the perf-attribution overhead gate (periodic "
      "instrument_step capture amortized <=2% vs attribution off)."),

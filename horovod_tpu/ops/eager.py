@@ -49,11 +49,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..common import compat
 from ..common import hvd_logging as log
 from ..common import state as state_mod
+from ..parallel import mesh as mesh_lib
 from ..common.exceptions import (DuplicateNameError, MismatchError,
                                  RanksLostError, ShutdownError,
                                  StalledError)
@@ -1435,7 +1436,7 @@ class EagerCoordinator:
     # -- execution engines --
 
     def _sharding(self, spec):
-        return NamedSharding(self._mesh, spec)
+        return mesh_lib.named_sharding(spec, self._mesh)
 
     @functools.cached_property
     def _stacked_psum(self):

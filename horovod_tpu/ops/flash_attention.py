@@ -802,7 +802,7 @@ def _vjp_bwd(causal, block_q, block_k, interpret, scale, block_q_dkv,
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def decode_attention(q, k, v, lengths, scale=None):
+def decode_attention(q, k, v, lengths, scale=None, head_sharding=None):
     """Single-query attention against a cached K/V prefix — the decode
     step of the serving plane (docs/serving.md).
 
@@ -813,6 +813,12 @@ def decode_attention(q, k, v, lengths, scale=None):
     lengths   [batch] int32 — valid prefix length per row
     scale     optional softmax scale (default head_dim ** -0.5, matching
               flash_attention)
+    head_sharding  optional NamedSharding over the head axis
+              (parallel.mesh.decode_head_sharding): constrains q/k/v so
+              the tensor-parallel serving path keeps attention
+              embarrassingly parallel over heads — each chip attends
+              its own heads/tp slice of the cache, no cross-chip
+              traffic until the output projection's psum
 
     Deliberately plain XLA rather than a Pallas kernel: with q_len == 1
     the QK^T product is a [s_max, d] GEMV per (batch, head) — there is no
@@ -831,6 +837,10 @@ def decode_attention(q, k, v, lengths, scale=None):
     if q.ndim != 4 or q.shape[1] != 1:
         raise ValueError(f"decode_attention wants q [b, 1, h, d], got "
                          f"{q.shape}")
+    if head_sharding is not None:
+        q = jax.lax.with_sharding_constraint(q, head_sharding)
+        k = jax.lax.with_sharding_constraint(k, head_sharding)
+        v = jax.lax.with_sharding_constraint(v, head_sharding)
     b, _, h, d = q.shape
     s_max = k.shape[1]
     scale = d ** -0.5 if scale is None else scale

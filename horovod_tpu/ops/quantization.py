@@ -288,22 +288,28 @@ def wire_nbytes(payload, scales=None):
     return int(nb)
 
 
-def account(codec, raw_nbytes, wire_nb):
+def account(codec, raw_nbytes, wire_nb, axis="dp"):
     """Fold one executed collective into the wire metrics: encoded
-    bytes by codec plus the live raw/wire compression ratio."""
+    bytes by codec plus the live raw/wire compression ratio. ``axis``
+    names the mesh axis the collective rode (the eager Horovod wire is
+    the dp axis; the named-mesh data plane attributes tp/sp collectives
+    separately via parallel.mesh.account_axis_bytes)."""
     reg = hvd_metrics.get_registry()
     if not reg.enabled:
         return
     reg.counter(
         "hvd_wire_bytes_total",
         "Encoded allreduce payload bytes that crossed (or would cross) "
-        "the wire, by codec; 'none' counts full-width buffers.",
-        labels=("codec",)).labels(codec=codec or "none").inc(int(wire_nb))
+        "the wire, by codec and mesh axis; 'none' counts full-width "
+        "buffers.",
+        labels=("codec", "axis")).labels(
+            codec=codec or "none", axis=axis or "dp").inc(int(wire_nb))
     reg.counter(
         "hvd_wire_raw_bytes_total",
         "Full-width bytes of the same buffers before encoding, by "
-        "codec — hvd_wire_bytes_total's denominator.",
-        labels=("codec",)).labels(codec=codec or "none").inc(
+        "codec and mesh axis — hvd_wire_bytes_total's denominator.",
+        labels=("codec", "axis")).labels(
+            codec=codec or "none", axis=axis or "dp").inc(
             int(raw_nbytes))
     if wire_nb:
         reg.gauge(

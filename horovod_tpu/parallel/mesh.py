@@ -18,12 +18,22 @@ order, which on multi-slice topologies means the DCN dimension):
 """
 
 import collections
+import os
+import threading
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("dp", "pp", "tp", "sp", "ep")
+
+# The process-global named mesh (docs/mesh.md). One mesh per process, fixed
+# for the life of the run: training, checkpointing and serving all place
+# arrays through it, so a layout change is a restart (cross-layout restore
+# handles the checkpoint side). Guarded by a lock only for the installation
+# race; readers see a committed mesh or None.
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_MESH = None
 
 
 def build_mesh(dp=None, pp=1, tp=1, sp=1, ep=1, devices=None,
@@ -88,3 +98,199 @@ def infer_slice_structure(devices=None):
 
 def mesh_axis_size(mesh, name):
     return mesh.shape[name] if name in mesh.shape else 1
+
+
+def parse_mesh_spec(spec):
+    """Parse a ``HOROVOD_MESH`` spec string into an axis-size dict.
+
+    Grammar: comma-separated ``axis=size`` pairs over the named axes
+    (``"dp=2,tp=4"``). ``dp`` may be omitted — ``build_mesh`` infers it
+    from the device count. Unknown axes and non-positive sizes fail loud
+    (a silent typo here would train on the wrong layout).
+    """
+    sizes = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"HOROVOD_MESH entry {part!r} is not axis=size (axes: {AXES})")
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in AXES:
+            raise ValueError(
+                f"HOROVOD_MESH axis {name!r} unknown (axes: {AXES})")
+        if name in sizes:
+            raise ValueError(f"HOROVOD_MESH axis {name!r} given twice")
+        try:
+            size = int(val)
+        except ValueError:
+            raise ValueError(
+                f"HOROVOD_MESH size for {name!r} is not an int: {val!r}")
+        if size < 1:
+            raise ValueError(f"HOROVOD_MESH size for {name!r} must be >= 1")
+        sizes[name] = size
+    return sizes
+
+
+def mesh_from_env(devices=None, environ=None):
+    """Build the data-plane mesh from the environment knobs.
+
+    ``HOROVOD_MESH`` (full ``axis=size`` spec) wins; otherwise the
+    per-axis integer knobs ``HOROVOD_MESH_TP`` / ``HOROVOD_MESH_SP`` /
+    ``HOROVOD_MESH_PP`` / ``HOROVOD_MESH_EP`` fill in and ``dp`` absorbs
+    the remaining devices. With nothing set this is the pure-dp mesh the
+    pre-mesh data plane always ran on, so dp-only runs are unchanged.
+    """
+    env = os.environ if environ is None else environ
+    spec = env.get("HOROVOD_MESH", "")
+    if spec:
+        sizes = parse_mesh_spec(spec)
+    else:
+        sizes = {}
+        for axis, var in (("tp", "HOROVOD_MESH_TP"), ("sp", "HOROVOD_MESH_SP"),
+                          ("pp", "HOROVOD_MESH_PP"), ("ep", "HOROVOD_MESH_EP")):
+            raw = env.get(var, "")
+            if raw:
+                sizes[axis] = int(raw)
+    return build_mesh(dp=sizes.get("dp"),
+                      pp=sizes.get("pp", 1), tp=sizes.get("tp", 1),
+                      sp=sizes.get("sp", 1), ep=sizes.get("ep", 1),
+                      devices=devices)
+
+
+def _publish_axis_gauges(mesh):
+    from ..utils import metrics
+    gauge = metrics.get_registry().gauge(
+        "hvd_mesh_axis_size",
+        "Size of each named axis of the process-global mesh (docs/mesh.md)",
+        labels=("axis",))
+    for axis in mesh.axis_names:
+        gauge.labels(axis=axis).set(mesh.shape[axis])
+
+
+def set_global_mesh(mesh):
+    """Install ``mesh`` as the process-global data-plane mesh.
+
+    Idempotent for the same mesh; replacing a different committed mesh is
+    an error — arrays already placed on the old mesh would silently
+    cross-reshard on the next collective. Tests use
+    ``reset_global_mesh()`` between layouts.
+    """
+    global _GLOBAL_MESH
+    with _GLOBAL_LOCK:
+        if _GLOBAL_MESH is not None and _GLOBAL_MESH is not mesh \
+                and dict(_GLOBAL_MESH.shape) != dict(mesh.shape):
+            raise RuntimeError(
+                f"global mesh already set to {dict(_GLOBAL_MESH.shape)}; "
+                f"refusing to replace with {dict(mesh.shape)} "
+                "(reset_global_mesh() first)")
+        _GLOBAL_MESH = mesh
+    _publish_axis_gauges(mesh)
+    return mesh
+
+
+def global_mesh(devices=None):
+    """The process-global mesh, lazily built from the env knobs.
+
+    First call wins: it builds from ``HOROVOD_MESH`` (or the per-axis
+    knobs) over ``devices`` and installs the result; later calls return
+    the committed mesh regardless of env changes.
+    """
+    with _GLOBAL_LOCK:
+        if _GLOBAL_MESH is not None:
+            return _GLOBAL_MESH
+    return set_global_mesh(mesh_from_env(devices=devices))
+
+
+def global_mesh_if_set():
+    """The committed global mesh, or None — never triggers a lazy build."""
+    return _GLOBAL_MESH
+
+
+def reset_global_mesh():
+    """Drop the committed global mesh (test isolation between layouts)."""
+    global _GLOBAL_MESH
+    with _GLOBAL_LOCK:
+        _GLOBAL_MESH = None
+
+
+def _resolve(mesh):
+    return global_mesh() if mesh is None else mesh
+
+
+def axis_size(name, mesh=None):
+    return mesh_axis_size(_resolve(mesh), name)
+
+
+def mesh_layout(mesh=None):
+    """Plain ``{axis: size}`` dict — the form checkpoint manifests record."""
+    return {a: int(s) for a, s in _resolve(mesh).shape.items()}
+
+
+def named_sharding(spec, mesh=None):
+    """The one sanctioned ``NamedSharding`` constructor (hvdlint HVD019).
+
+    Every placement in trainer/serving/ops goes through here (or the
+    tree-wide wrappers below) so the whole data plane shares a single
+    mesh contract instead of scattering inline ``NamedSharding(mesh, ...)``
+    constructions that drift when the layout changes.
+    """
+    return NamedSharding(_resolve(mesh), spec if spec is not None else P())
+
+
+def tree_shardings(spec_tree, mesh=None):
+    """Map a PartitionSpec tree to a matching NamedSharding tree."""
+    mesh = _resolve(mesh)
+    return jax.tree_util.tree_map(lambda s: named_sharding(s, mesh),
+                                  spec_tree)
+
+
+def device_put_tree(tree, spec_tree, mesh=None):
+    """Tree-wide ``device_put``: place every leaf of ``tree`` on the mesh
+    according to the matching leaf of ``spec_tree`` (one transfer batch,
+    not a per-leaf python loop)."""
+    return jax.device_put(tree, tree_shardings(spec_tree, mesh))
+
+
+def replicate_tree(tree, mesh=None):
+    """Place every leaf fully replicated (spec ``P()``) on the mesh."""
+    shard = named_sharding(P(), mesh)
+    return jax.device_put(
+        tree, jax.tree_util.tree_map(lambda _: shard, tree))
+
+
+def kv_cache_spec(num_heads, mesh=None):
+    """PartitionSpec for the serving KV cache ``[layers, slots, len,
+    heads, head_dim]``: heads sharded over tp when tp divides them,
+    replicated otherwise (docs/serving.md, docs/mesh.md)."""
+    mesh = _resolve(mesh)
+    tp = mesh_axis_size(mesh, "tp")
+    if tp > 1 and num_heads % tp == 0:
+        return P(None, None, None, "tp", None)
+    return P()
+
+
+def decode_head_sharding(num_heads):
+    """Trace-time hint for the fused decode step: the head-sharded
+    NamedSharding for ``[batch, s, heads, head_dim]`` activations when a
+    global mesh with tp>1 dividing ``num_heads`` is committed, else None
+    (dp-only engines stay byte-identical). Reads the committed mesh only
+    — never triggers a lazy env build from inside a trace."""
+    mesh = global_mesh_if_set()
+    if mesh is None:
+        return None
+    tp = mesh_axis_size(mesh, "tp")
+    if tp > 1 and num_heads % tp == 0:
+        return named_sharding(P(None, None, "tp", None), mesh)
+    return None
+
+
+def account_axis_bytes(axis, nbytes, codec="none"):
+    """Attribute collective payload bytes to a mesh axis on the
+    ``hvd_wire_bytes_total{codec,axis}`` counter so ``hvd_top`` and the
+    roofline decomposition can split tp-axis comm from dp (docs/metrics.md).
+    The mesh path is uncompressed, so raw == wire."""
+    from ..ops import quantization
+    quantization.account(codec, int(nbytes), int(nbytes), axis=axis)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..models.transformer import _dispatch_attention, _rope
 from ..ops.flash_attention import decode_attention
+from ..parallel import mesh as mesh_lib
 
 
 def _dense(x, kernel, dtype):
@@ -126,13 +127,17 @@ def decode_step(cfg, params, tokens, positions, kv_k, kv_v):
     pos2 = positions[:, None]  # [b, 1] per-row positions for rope
     x = _embed(cfg, params, tokens[:, None])
     lengths = positions + 1
+    # trace-time hint: head-sharded attention over the committed global
+    # mesh's tp axis (None on dp-only engines — byte-identical program)
+    heads = mesh_lib.decode_head_sharding(cfg.num_heads)
     for i in range(cfg.num_layers):
         layer = params[f"layer_{i}"]
         y = _rmsnorm(x, layer["ln_attn"]["scale"], cfg.dtype)
         q, k, v = _qkv(cfg, layer, y, pos2)
         kv_k = kv_k.at[i, rows, positions].set(k[:, 0])
         kv_v = kv_v.at[i, rows, positions].set(v[:, 0])
-        attn = decode_attention(q, kv_k[i], kv_v[i], lengths)
+        attn = decode_attention(q, kv_k[i], kv_v[i], lengths,
+                                head_sharding=heads)
         attn = attn.reshape(b, 1, cfg.d_model)
         x = x + _dense(attn, layer["attn"]["out"]["kernel"], cfg.dtype)
         y = _rmsnorm(x, layer["ln_mlp"]["scale"], cfg.dtype)

@@ -100,8 +100,15 @@ class ServeEngine:
                  kv_block=None, total_blocks=None, policy="continuous",
                  queue=None, seed=0, replica=None, on_ranks_lost=None,
                  subscriber=None, generation=None, clock=time.monotonic,
-                 swap_gate=None):
+                 swap_gate=None, mesh=None):
         self.cfg = cfg
+        # Tensor-parallel serving (docs/mesh.md): with a mesh whose tp
+        # axis is >1, params are placed by the model's spec tree
+        # (Megatron column/row split) and the KV cache is head-sharded;
+        # GSPMD then shards prefill/decode over the same mesh. mesh=None
+        # is the unsharded single-chip engine, byte-identical to before.
+        self.mesh = mesh
+        params = self._place_params(params)
         self.params = params
         # fleet plane (docs/fleet.md): the subscriber feeds armed weight
         # generations; swaps happen at step boundaries in _maybe_swap.
@@ -122,7 +129,8 @@ class ServeEngine:
         num_slots = (config.env_int("SERVE_SLOTS", 8)
                      if num_slots is None else num_slots)
         self.kv = KVCache(cfg, num_slots, max_len=max_len,
-                          block_size=kv_block, total_blocks=total_blocks)
+                          block_size=kv_block, total_blocks=total_blocks,
+                          mesh=mesh)
         self.scheduler = SlotScheduler(num_slots, policy=policy)
         self.queue = queue if queue is not None else AdmissionQueue()
         self._clock = clock
@@ -296,6 +304,18 @@ class ServeEngine:
 
     # -- internals ------------------------------------------------------
 
+    def _place_params(self, params):
+        """Place a weight tree on the engine's mesh through the model's
+        spec tree — every path params enter the engine (__init__ and
+        hot swaps) goes through here so a swapped-in generation shards
+        exactly like the one it replaces."""
+        if self.mesh is None:
+            return params
+        from ..models.transformer import param_specs
+        from ..parallel import mesh as mesh_lib
+        return mesh_lib.device_put_tree(params, param_specs(params),
+                                        self.mesh)
+
     def _maybe_swap(self):
         """Zero-drain hot swap at the step boundary (docs/fleet.md):
         poll the subscriber (cheap: one stat, rate-limited), and if a
@@ -315,8 +335,9 @@ class ServeEngine:
         if rec is None:
             return
         old_gen, gen = self._generation, rec.generation
-        self.params = rec.params
-        self._params_by_gen[gen] = rec.params
+        new_params = self._place_params(rec.params)
+        self.params = new_params
+        self._params_by_gen[gen] = new_params
         self._generation = gen
         self._prune_params()
         now = sub.clock()  # the subscriber's clock stamped rec

@@ -137,7 +137,7 @@ class KVCache:
     """
 
     def __init__(self, cfg, num_slots, max_len=None, block_size=None,
-                 total_blocks=None):
+                 total_blocks=None, mesh=None):
         import jax.numpy as jnp
         max_len = cfg.max_seq_len if max_len is None else max_len
         self.ledger = BlockLedger(num_slots, max_len,
@@ -148,7 +148,33 @@ class KVCache:
                  head_dim)
         self.k = jnp.zeros(shape, cfg.dtype)
         self.v = jnp.zeros(shape, cfg.dtype)
+        if mesh is not None:
+            # Tensor-parallel serving (docs/mesh.md): the dense arrays
+            # gain a head-sharded NamedSharding over the mesh's tp axis,
+            # so each chip holds heads/tp of the cache — the per-chip
+            # memory win that lets one replica front a model bigger
+            # than a chip. Replicated when tp doesn't divide heads.
+            from ..parallel import mesh as mesh_lib
+            spec = mesh_lib.kv_cache_spec(cfg.num_heads, mesh)
+            self.k, self.v = mesh_lib.device_put_tree(
+                (self.k, self.v), (spec, spec), mesh)
         self.max_len = max_len
+
+    def per_chip_bytes(self):
+        """Bytes of K+V cache resident on ONE chip (the shard shape
+        under the cache's committed sharding; the full array size when
+        unsharded) — what the HVD_BENCH_MESH serve arm asserts drops
+        with tp."""
+        import numpy as np
+        total = 0
+        for arr in (self.k, self.v):
+            sharding = getattr(arr, "sharding", None)
+            if sharding is not None and hasattr(sharding, "shard_shape"):
+                shape = sharding.shard_shape(arr.shape)
+            else:
+                shape = arr.shape
+            total += int(np.prod(shape)) * arr.dtype.itemsize
+        return total
 
     @property
     def num_slots(self):

@@ -21,12 +21,13 @@ import time
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from .common import compat
 from .common.config import env_bool, env_int
 from .common.exceptions import PREEMPTED_EXIT_CODE
 from . import optim
+from .parallel import mesh as mesh_lib
 from .ops.compression import Compression
 from .utils import checkpoint as hvd_checkpoint
 from .utils import metrics as hvd_metrics
@@ -229,11 +230,11 @@ class Checkpointer:
 
     def __init__(self, directory, every=None, keep=None, async_save=None,
                  preemption=None, rank=0, world_size=1, manager=None,
-                 verbose=False, publish=None):
+                 verbose=False, publish=None, layout=None):
         self.every = env_int("CKPT_EVERY", 0) if every is None else int(every)
         self.manager = manager or hvd_checkpoint.CheckpointManager(
             directory, rank=rank, world_size=world_size, keep=keep,
-            async_save=async_save)
+            async_save=async_save, layout=layout)
         # fleet plane (docs/fleet.md): publish every commit as a weight
         # generation serving replicas can hot-swap to. The publisher
         # recovers its generation counter from the existing pointer, so
@@ -281,13 +282,19 @@ class Checkpointer:
     def preempted(self):
         return self._preempt.is_set()
 
-    def resume(self, like=None):
+    def resume(self, like=None, mesh=None, spec_tree=None):
         """(state, start_step, extra) — the checkpointed state when one
         exists, else ``(like, 0, {})``. Feed the tree through
-        ``broadcast_parameters`` on multi-rank jobs for consistency."""
+        ``broadcast_parameters`` on multi-rank jobs for consistency.
+
+        Pass ``spec_tree`` (PartitionSpec tree matching ``like``) to
+        re-place the restored leaves on the mesh — the cross-layout
+        restore path: the checkpoint may have been saved under a
+        different dp×tp×sp factorization (docs/mesh.md)."""
         if not self.manager.exists():
             return like, 0, {}
-        tree, step, extra = self.manager.restore(like=like)
+        tree, step, extra = self.manager.restore(like=like, mesh=mesh,
+                                                 spec_tree=spec_tree)
         if self.verbose:
             print(f"checkpoint: resumed step {step} from "
                   f"{self.manager.directory}")
@@ -421,8 +428,9 @@ def opt_state_specs(tx, params, param_spec_tree):
         transform_non_params=lambda _: P())
 
 
-def init_opt_state(tx, params, mesh, param_spec_tree=None):
-    """``tx.init(params)`` placed on the mesh: leaves mirroring a param
+def init_opt_state(tx, params, mesh=None, param_spec_tree=None):
+    """``tx.init(params)`` placed on the mesh (the process-global mesh
+    when ``mesh`` is None): leaves mirroring a param
     (mu/nu/trace) take that param's sharding, scalars (step counts) are
     replicated. Use this instead of a bare ``tx.init`` with sharded steps —
     a host-created state's scalar avals lack the mesh context, so the first
@@ -431,9 +439,8 @@ def init_opt_state(tx, params, mesh, param_spec_tree=None):
     compile time."""
     if param_spec_tree is None:
         param_spec_tree = jax.tree_util.tree_map(lambda _: P(), params)
-    shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s),
-        opt_state_specs(tx, params, param_spec_tree))
+    shardings = mesh_lib.tree_shardings(
+        opt_state_specs(tx, params, param_spec_tree), mesh)
     return jax.jit(tx.init, out_shardings=shardings)(params)
 
 
@@ -442,17 +449,13 @@ def _gspmd_shardings(tx, mesh, param_spec_tree, batch_spec, params):
     make_gspmd_multi_step: (param, opt, batch, out) NamedShardings.
     opt/out are None when ``params`` is not given (see the callers'
     docstrings for why passing it matters)."""
-
-    def to_sharding(spec):
-        return NamedSharding(mesh, spec)
-
-    param_shardings = jax.tree_util.tree_map(to_sharding, param_spec_tree)
-    batch_sharding = to_sharding(batch_spec)
+    param_shardings = mesh_lib.tree_shardings(param_spec_tree, mesh)
+    batch_sharding = mesh_lib.named_sharding(batch_spec, mesh)
     if params is not None:
-        opt_shardings = jax.tree_util.tree_map(
-            to_sharding, opt_state_specs(tx, params, param_spec_tree))
+        opt_shardings = mesh_lib.tree_shardings(
+            opt_state_specs(tx, params, param_spec_tree), mesh)
         out_shardings = (param_shardings, opt_shardings,
-                         NamedSharding(mesh, P()))
+                         mesh_lib.named_sharding(P(), mesh))
     else:
         opt_shardings = None
         out_shardings = None
@@ -463,7 +466,8 @@ def make_gspmd_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
                     donate=True, params=None):
     """Sharding-annotated train step: params placed by ``param_spec_tree``
     (e.g. models.transformer.param_specs), batch by ``batch_spec``; XLA
-    (GSPMD) inserts all tp/sp/dp collectives over ICI.
+    (GSPMD) inserts all tp/sp/dp collectives over ICI. ``mesh=None``
+    targets the process-global mesh (parallel.mesh.global_mesh).
 
     Pass ``params`` (the concrete or abstract param tree) so the optimizer
     state's shardings can be derived too and every step argument/result is
@@ -531,12 +535,10 @@ def make_gspmd_multi_step(loss_fn, tx, mesh, param_spec_tree, batch_spec,
 
 
 def place(tree, mesh, spec_tree):
-    """device_put a pytree according to a PartitionSpec pytree."""
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        tree, spec_tree)
+    """device_put a pytree according to a PartitionSpec pytree
+    (``mesh=None`` targets the process-global mesh)."""
+    return mesh_lib.device_put_tree(tree, spec_tree, mesh)
 
 
-def replicate(tree, mesh):
-    return place(tree, mesh,
-                 jax.tree_util.tree_map(lambda _: P(), tree))
+def replicate(tree, mesh=None):
+    return mesh_lib.replicate_tree(tree, mesh)

@@ -412,6 +412,46 @@ def restore_with_extra(path, like=None, step=None, verify=None):
     return tree, got_step, {}
 
 
+def saved_layout(path, step=None):
+    """The mesh layout ({axis: size}) the checkpoint at ``path`` was
+    saved under, or None for pre-mesh / format-1 checkpoints. Purely
+    informational for restore — format-2 shards hold FULL leaf values
+    (each rank owns whole leaves round-robin, not slices), so any
+    layout can restore any checkpoint; this records what to log when
+    they differ."""
+    steps = _committed_steps(path)
+    if not steps:
+        return None
+    if step is None:
+        step = max(steps)
+    elif step not in steps:
+        return None
+    return _read_global_manifest(steps[step]).get("layout")
+
+
+def restore_on_mesh(path, like, spec_tree, mesh=None, step=None,
+                    verify=None):
+    """Cross-layout restore (docs/mesh.md): load a checkpoint saved
+    under ANY mesh layout and re-sled every leaf through ``spec_tree``
+    onto the restore-time mesh (the process-global mesh when ``mesh``
+    is None) -> (tree, step, extra).
+
+    Shards hold full leaf values, so this is bit-exact regardless of
+    the save-time dp×tp×sp factorization — only the placement changes.
+    A save under dp×tp=2×4 restores under 4×2 (or 8×1) with identical
+    bytes on every param/optimizer leaf.
+    """
+    from ..parallel import mesh as mesh_lib
+    tree, got_step, extra = restore_with_extra(path, like=like, step=step,
+                                               verify=verify)
+    was = saved_layout(path, step=step)
+    now = mesh_lib.mesh_layout(mesh)
+    if was is not None and dict(was) != now:
+        _registry().event("ckpt_cross_layout_restore", step=int(got_step),
+                          saved=dict(was), restored=now)
+    return mesh_lib.device_put_tree(tree, spec_tree, mesh), got_step, extra
+
+
 def exists(path):
     return bool(_committed_steps(path)) or _legacy_dir(path) is not None
 
@@ -531,8 +571,14 @@ class CheckpointManager:
 
     def __init__(self, directory, rank=0, world_size=1, keep=None,
                  async_save=None, shard=None, commit_timeout_s=120.0,
-                 on_commit=None):
+                 on_commit=None, layout=None):
         self.directory = directory
+        # mesh layout of the saving job ({axis: size}, e.g. dp=2 tp=4) —
+        # recorded in every global manifest so a restore under a
+        # DIFFERENT layout knows what it is resharding from
+        # (docs/mesh.md "cross-layout restore"). None for pre-mesh jobs.
+        self.layout = ({str(k): int(v) for k, v in dict(layout).items()}
+                       if layout else None)
         # rank-0 post-commit hook: on_commit(step, step_dir, manifest)
         # runs on the writer thread after the manifest rename and BEFORE
         # retention GC — the fleet plane's WeightPublisher hangs its
@@ -620,7 +666,8 @@ class CheckpointManager:
         job = (int(step), names, arrays,
                dict(extra) if extra else {},
                kind or ("sync" if (block or not self.async_save)
-                        else "async"))
+                        else "async"),
+               self.layout)
         if block or not self.async_save:
             # drain any queued/in-flight write first so commits stay
             # step-ordered (an emergency save must land newest-last)
@@ -649,10 +696,17 @@ class CheckpointManager:
             raise CheckpointError(
                 f"checkpoint writer did not drain within {timeout}s")
 
-    def restore(self, like=None, step=None, verify=None):
+    def restore(self, like=None, step=None, verify=None, mesh=None,
+                spec_tree=None):
         """(tree, step, extra) from the newest committed checkpoint
         (either format — a plane upgrade restores pre-plane
-        checkpoints)."""
+        checkpoints). Pass ``spec_tree`` (and optionally ``mesh``) to
+        re-place the restored leaves on the mesh — the cross-layout
+        path (``restore_on_mesh``); without it leaves come back as host
+        arrays placed by the caller."""
+        if spec_tree is not None:
+            return restore_on_mesh(self.directory, like, spec_tree,
+                                   mesh=mesh, step=step, verify=verify)
         return restore_with_extra(self.directory, like=like, step=step,
                                   verify=verify)
 
@@ -705,7 +759,7 @@ class CheckpointManager:
             return list(range(n)) if self.rank == 0 else []
         return list(range(self.rank, n, self.world_size))
 
-    def _write(self, step, names, arrays, extra, kind):
+    def _write(self, step, names, arrays, extra, kind, layout=None):
         t0 = time.perf_counter()
         ins = self._instruments()
         d = _step_dir(self.directory, step)
@@ -748,6 +802,8 @@ class CheckpointManager:
             "extra": extra, "ranks": sorted(rank_manifests),
             "files": files,
         }
+        if layout is not None:
+            manifest["layout"] = layout
         _failpoint("pre_commit")
         mpayload = json.dumps(manifest).encode()
         tmp = os.path.join(d, f"{_MANIFEST}.tmp-{os.getpid()}")

@@ -1326,6 +1326,103 @@ def test_hvd018_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD019 — ad-hoc sharding outside the mesh plane
+# ---------------------------------------------------------------------------
+
+def test_hvd019_triggers_on_bare_namedsharding(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mesh_path
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(x, mesh):
+            return jax.device_put(x, NamedSharding(mesh, P("dp")))
+        """)
+    assert [f.rule for f in live(found)] == ["HVD019"]
+
+
+def test_hvd019_triggers_on_device_put_with_inline_mesh(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mesh_path
+        import jax
+        from jax.sharding import Mesh
+
+        def place(x, devices):
+            return jax.device_put(x, Mesh(devices, ("dp",)))
+        """)
+    assert [f.rule for f in live(found)] == ["HVD019"]
+
+
+def test_hvd019_sees_through_import_aliases(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mesh_path
+        from jax.sharding import NamedSharding as NS
+
+        def place(x, mesh, spec):
+            return NS(mesh, spec)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD019"]
+
+
+def test_hvd019_mesh_lib_helpers_are_sanctioned(tmp_path):
+    # the fix the rule points at: specs routed through parallel/mesh.py
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mesh_path
+        from horovod_tpu.parallel import mesh as mesh_lib
+        from jax.sharding import PartitionSpec as P
+
+        def place(tree, spec_tree, mesh):
+            s = mesh_lib.named_sharding(P("dp"), mesh)
+            return mesh_lib.device_put_tree(tree, spec_tree, mesh)
+        """)
+    assert live(found, "HVD019") == []
+
+
+def test_hvd019_scoped_to_data_plane_modules(tmp_path):
+    # no role marker, not under trainer/serving/ops: out of scope
+    found = lint_source(tmp_path, """\
+        from jax.sharding import NamedSharding
+
+        def place(x, mesh, spec):
+            return NamedSharding(mesh, spec)
+        """)
+    assert live(found, "HVD019") == []
+
+
+def test_hvd019_fires_under_serving_without_marker_but_not_in_mesh_py(
+        tmp_path):
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    src = ("from jax.sharding import NamedSharding\n\n"
+           "def place(x, mesh, spec):\n"
+           "    return NamedSharding(mesh, spec)\n")
+    serve = tmp_path / "horovod_tpu" / "serving"
+    serve.mkdir(parents=True)
+    (serve / "warm.py").write_text(src)
+    plane = tmp_path / "horovod_tpu" / "parallel"
+    plane.mkdir(parents=True)
+    (plane / "mesh.py").write_text(src)
+    findings, _ = analyze_paths(
+        [str(serve / "warm.py"), str(plane / "mesh.py")],
+        env_registry_path=str(reg))
+    assert [(f.rule, "serving" in f.file) for f in live(findings)] == \
+        [("HVD019", True)]
+
+
+def test_hvd019_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mesh_path
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def rendezvous_sharding(mesh):
+            # hvdlint: disable=HVD019(per-process rendezvous mesh, not the data plane)
+            return NamedSharding(mesh, P("proc"))
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD019"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -1385,7 +1482,7 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 19)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 20)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

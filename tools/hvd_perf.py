@@ -87,6 +87,11 @@ LEGS = (
         higher_better=False),
     Leg("ckpt_overhead_pct", ("ckpt", "overhead_pct"),
         higher_better=False),
+    Leg("mesh_tp2_vs_dp_ratio", ("mesh", "tp2_vs_dp_ratio"),
+        context_paths=(("mesh", "devices"), ("mesh", "global_batch"))),
+    Leg("mesh_serve_kv_per_chip_ratio",
+        ("mesh", "serve", "kv_per_chip_bytes_ratio"),
+        context_paths=(("mesh", "devices"),)),
     Leg("overlap_frac", ("overlap", "overlap_frac"),
         context_paths=_OVERLAP_CTX),
     Leg("overlap_exposed_comm_ms", ("overlap", "exposed_comm_ms_on"),

@@ -269,6 +269,22 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
             for k, v in sorted(enc.items()))
         ratio = _total(snap, "hvd_wire_compression_ratio")
         lines.append(f"    wire codecs   {mix}   live ratio x{ratio:.2f}")
+    # per-axis wire split (named-mesh data plane, docs/mesh.md): shown
+    # only when a non-dp axis has moved bytes — pure-dp runs keep the
+    # frame unchanged
+    by_axis = _by_label(snap, "hvd_wire_bytes_total", "axis")
+    by_axis.pop("", None)
+    if set(by_axis) - {"dp"}:
+        mix = "  ".join(f"{k}={_fmt_bytes(v)}"
+                        for k, v in sorted(by_axis.items()))
+        lines.append(f"    wire axes     {mix}")
+    mesh_axes = _by_label(snap, "hvd_mesh_axis_size", "axis")
+    if mesh_axes:
+        order = ("dp", "pp", "tp", "sp", "ep")
+        shown = [a for a in order if a in mesh_axes]
+        shown += sorted(set(mesh_axes) - set(order))
+        shape = " ".join(f"{a}={int(mesh_axes[a])}" for a in shown)
+        lines.append(f"    mesh          {shape}")
 
     # robustness
     retries = _total(snap, "hvd_transport_retries_total")
@@ -685,12 +701,19 @@ def canned_snapshot():
         fill.observe(v)
     reg.counter("hvd_fusion_buckets_total", "c").inc(420)
     reg.counter("hvd_fusion_bytes_total", "c").inc(3 << 30)
-    we = reg.counter("hvd_wire_bytes_total", "c", labels=("codec",))
-    we.labels(codec="int8").inc(780 << 20)
-    we.labels(codec="none").inc(512 << 20)
-    wr = reg.counter("hvd_wire_raw_bytes_total", "c", labels=("codec",))
-    wr.labels(codec="int8").inc(3 << 30)
-    wr.labels(codec="none").inc(512 << 20)
+    we = reg.counter("hvd_wire_bytes_total", "c", labels=("codec", "axis"))
+    we.labels(codec="int8", axis="dp").inc(780 << 20)
+    we.labels(codec="none", axis="dp").inc(512 << 20)
+    we.labels(codec="none", axis="tp").inc(96 << 20)
+    wr = reg.counter("hvd_wire_raw_bytes_total", "c",
+                     labels=("codec", "axis"))
+    wr.labels(codec="int8", axis="dp").inc(3 << 30)
+    wr.labels(codec="none", axis="dp").inc(512 << 20)
+    wr.labels(codec="none", axis="tp").inc(96 << 20)
+    ms = reg.gauge("hvd_mesh_axis_size", "g", labels=("axis",))
+    for axis, size in (("dp", 2), ("pp", 1), ("tp", 2), ("sp", 2),
+                       ("ep", 1)):
+        ms.labels(axis=axis).set(size)
     reg.gauge("hvd_wire_compression_ratio", "g").set(3.94)
     reg.gauge("hvd_ef_residual_norm", "g", labels=("tensor",)).labels(
         tensor="grad/embed").set(0.42)

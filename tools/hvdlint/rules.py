@@ -1404,6 +1404,94 @@ def check_unbounded_retry_loop(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD019 — ad-hoc sharding outside the mesh plane
+# ---------------------------------------------------------------------------
+
+# the one sanctioned NamedSharding constructor lives here
+_MESH_PLANE_SUFFIX = "horovod_tpu/parallel/mesh.py"
+_MESH_SCOPE_DIRS = ("horovod_tpu/serving/", "horovod_tpu/ops/")
+_MESH_SCOPE_FILES = ("horovod_tpu/trainer.py",)
+_SHARDING_CTORS = {"NamedSharding", "Mesh"}
+
+
+def _sharding_aliases(tree):
+    """Local names bound to jax.sharding.{NamedSharding, Mesh} via
+    ``from ... import`` (with or without ``as``)."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                "sharding" in node.module.split("."):
+            for a in node.names:
+                if a.name in _SHARDING_CTORS:
+                    aliases[a.asname or a.name] = a.name
+    return aliases
+
+
+def _ctor_name(node, aliases):
+    """'NamedSharding'/'Mesh' when ``node`` constructs one, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Name):
+        return aliases.get(node.func.id)
+    chain = _attr_chain(node.func)
+    if chain and chain[-1] in _SHARDING_CTORS and len(chain) >= 2 and \
+            chain[-2] == "sharding":
+        return chain[-1]  # jax.sharding.NamedSharding(...) spelled out
+    return None
+
+
+def check_adhoc_sharding(ctx, shared):
+    if ctx.relpath.endswith(_MESH_PLANE_SUFFIX):
+        return
+    if "mesh_path" not in ctx.roles and not (
+            any(d in ctx.relpath for d in _MESH_SCOPE_DIRS) or
+            any(ctx.relpath.endswith(f) for f in _MESH_SCOPE_FILES)):
+        return
+    aliases = _sharding_aliases(ctx.tree)
+    flagged = set()
+    for node in ast.walk(ctx.tree):
+        name = _ctor_name(node, aliases)
+        if name == "NamedSharding":
+            flagged.add(id(node))
+            yield Finding(
+                "HVD019", ctx.relpath, node.lineno, node.col_offset,
+                "ad-hoc NamedSharding construction outside "
+                "parallel/mesh.py: a sharding built here bypasses the "
+                "data plane's one placement contract (docs/mesh.md) — "
+                "it can name axes the committed global mesh doesn't "
+                "have, pin arrays to a private mesh that silently "
+                "cross-reshards against the rest of the tree, and "
+                "hides wire traffic from the per-axis accounting. "
+                "Route placement through mesh_lib.named_sharding / "
+                "tree_shardings / device_put_tree; keep a local "
+                "construction only with a reason naming why the array "
+                "genuinely lives off the data-plane mesh.")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        is_dput = (chain is not None and chain[-1] == "device_put") or \
+            (isinstance(node.func, ast.Name) and
+             node.func.id == "device_put")
+        if not is_dput:
+            continue
+        inline = [n for arg in list(node.args) +
+                  [k.value for k in node.keywords]
+                  for n in ast.walk(arg)
+                  if _ctor_name(n, aliases) and id(n) not in flagged]
+        if not inline:
+            continue
+        yield Finding(
+            "HVD019", ctx.relpath, node.lineno, node.col_offset,
+            "jax.device_put with an inline mesh/sharding construction "
+            "outside parallel/mesh.py: placement decided at the call "
+            "site instead of through the spec-tree contract "
+            "(docs/mesh.md). Build the spec once and place with "
+            "mesh_lib.device_put_tree so training, checkpoint restore "
+            "and serving agree on where every leaf lives.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1954,5 +2042,42 @@ Fix: compute ``deadline = time.monotonic() + timeout_s`` before the
 loop and raise past it (run/mpi.py's rendezvous poll is the model),
 or bound attempts and surface the give-up as an event/exception.""",
             check_unbounded_retry_loop),
+        Rule(
+            "HVD019", "adhoc-sharding",
+            "NamedSharding / inline-mesh device_put outside "
+            "parallel/mesh.py in the data plane",
+            """HVD019 — ad-hoc sharding outside the mesh plane
+
+The named-mesh data plane (docs/mesh.md) has exactly one placement
+contract: a process-global Mesh committed by parallel/mesh.py, and
+PartitionSpec trees resolved to NamedShardings through
+``mesh_lib.named_sharding`` / ``tree_shardings`` /
+``device_put_tree``. Training, cross-layout checkpoint restore, and
+tensor-parallel serving all assume every data-plane leaf was placed
+through that contract.
+
+A ``NamedSharding(...)`` built at a call site — or a
+``jax.device_put`` carrying an inline ``NamedSharding``/``Mesh``
+construction — re-decides placement locally. The failure modes are
+quiet: the spec can name an axis the committed mesh doesn't have
+(raises only on the layout that ships), the array can land on a
+private mesh and silently cross-reshard against every collective
+that touches it, donation breaks when in_shardings disagree with the
+actual placement, and the transfer never reaches the per-axis wire
+accounting (hvd_wire_bytes_total{axis}).
+
+Scope: ``horovod_tpu/trainer.py``, ``horovod_tpu/serving/``,
+``horovod_tpu/ops/`` (fixtures opt in with ``# hvdlint:
+role=mesh_path``); ``parallel/mesh.py`` itself is the sanctioned
+constructor. The baselined sites are
+ops/process_collectives.py's rendezvous shardings — built over its
+own per-process grid mesh for host-side collectives, deliberately
+not the data plane.
+
+Fix: express placement as a PartitionSpec and route it through
+mesh_lib (``named_sharding(spec, mesh)`` accepts an explicit mesh
+for the rare off-global case); keep a local construction only with
+a reason naming why the array lives off the data-plane mesh.""",
+            check_adhoc_sharding),
     ]
 }
