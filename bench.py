@@ -1622,6 +1622,111 @@ def _bench_profile(window, meta):
     return out
 
 
+def _bench_mem(hvd, on_tpu, budget_pct=2.0):
+    """Memory-plane overhead gate (docs/memory.md); HVD_BENCH_MEM=0
+    skips.
+
+    The HBM ledger and the jit-site compile tracker are DEFAULT-ON
+    (HOROVOD_MEM=1), so their per-step cost on the real eager LM step
+    (bench_common.build_eager_lm_step, the exact path users run with
+    instrument_step) must stay inside the repo's <=2% observability
+    budget. Per step the plane costs one abstract-shape key (tree
+    leaves' dtype+shape tuples, no string work on a hit) plus a set
+    lookup; ledger accounting is event-driven (placement, swap), not
+    per-step, so it rides the untimed arm setup exactly as trainer/
+    engine init pay it.
+
+    Protocol mirrors _bench_quant: one instrument_step-wrapped step,
+    arms toggled via memory.reset(enabled=...), counterbalanced arm
+    order per round with an untimed toggle-warmup step, best-of-min
+    per arm, extra rounds only while a round lands over budget.
+    AssertionError past the budget — a CI gate, not a report. The
+    on-arm's ledger headroom and per-site compile hit/miss counts ride
+    the bench JSON (tools/hvd_perf.py leg mem_overhead_pct)."""
+    import time
+
+    import jax
+
+    from bench_common import build_eager_lm_step, flagship_config
+    from horovod_tpu import trainer
+    from horovod_tpu.utils import memory as hvd_memory
+
+    if on_tpu:
+        t_cfg = flagship_config(True, num_layers=4)
+        bps, seq, steps, rounds = 4, 512, 6, 3
+    else:
+        t_cfg = flagship_config(False)
+        # more rounds than the TPU shape: virtual chips share host
+        # cores, so single-window noise dwarfs the plane's cost and
+        # only best-of-many converges
+        bps, seq, steps, rounds = 2, 64, 3, 6
+    world = hvd.size()
+    step, params, opt, toks = build_eager_lm_step(t_cfg, world, bps,
+                                                  seq)
+    # wrap while the plane is live so the wrapper's gauge decisions
+    # (peak-HBM on TPU) match a default-on training run in both arms
+    hvd_memory.reset(enabled=True)
+    inst = trainer.instrument_step(step, name="mem_gate",
+                                   attrib_every=0)
+    # global untimed warmup: compile + negotiation plan + fusion state
+    # settle before EITHER arm is timed (the toggle warmup below only
+    # covers per-toggle costs)
+    for _ in range(3):
+        params, opt, loss = inst(params, opt, toks)
+    float(loss)
+
+    best = {"off": float("inf"), "on": float("inf")}
+    arms = ("off", "on")
+    for rd in range(rounds):
+        for mode in (arms if rd % 2 == 0 else arms[::-1]):
+            hvd_memory.reset(enabled=(mode == "on"))
+            if mode == "on":
+                # event-driven accounting, paid at placement time in a
+                # real run — untimed here for the same reason
+                hvd_memory.get_ledger().account_tree("params", params)
+            # untimed toggle warmup: first call after a toggle pays
+            # tracker/site setup
+            params, opt, loss = inst(params, opt, toks)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt, loss = inst(params, opt, toks)
+            float(loss)  # device->host read = true execution barrier
+            best[mode] = min(best[mode],
+                             (time.perf_counter() - t0) / steps * 1e3)
+        if best["on"] <= best["off"] * (1.0 + budget_pct / 100.0):
+            break
+
+    # the reported ledger/compile view: one enabled pass with full
+    # attribution, the state a default-on run would publish
+    hvd_memory.reset(enabled=True)
+    ledger = hvd_memory.get_ledger()
+    ledger.account_tree("params", params)
+    ledger.account_tree("opt_state", opt)
+    for _ in range(2):
+        params, opt, loss = inst(params, opt, toks)
+    float(loss)
+    snap = ledger.snapshot()
+    compile_sites = hvd_memory.get_tracker().site_summary()
+    hvd_memory.reset()  # back to the environment default
+
+    off, on = best["off"], best["on"]
+    overhead_pct = (on - off) / off * 100.0
+    out = {"world": world, "steps_per_window": steps,
+           "off_best_step_ms": round(off, 3),
+           "on_best_step_ms": round(on, 3),
+           "overhead_pct": round(overhead_pct, 2),
+           "budget_pct": budget_pct,
+           "ledger_total_bytes": snap["total_bytes"],
+           "headroom_bytes": snap["headroom_bytes"],
+           "capacity_bytes": snap["capacity_bytes"],
+           "compile_sites": compile_sites}
+    assert overhead_pct <= budget_pct, (
+        f"memory-plane overhead {overhead_pct:.2f}% exceeds the "
+        f"{budget_pct}% budget: {out}")
+    return out
+
+
 def _bench_perf_attrib(steps=64, attrib_every=64, rounds=3,
                        target_step_ms=60.0, budget_pct=2.0):
     """In-training attribution overhead contract (the perf-attribution
@@ -1902,6 +2007,14 @@ def main():
     perf_attrib = None
     if os.environ.get("HVD_BENCH_PERF", "") != "0":
         perf_attrib = _bench_perf_attrib()
+    # Memory-plane overhead gate: HBM ledger + jit-site compile
+    # tracking default-on vs off around the real eager LM step
+    # (interleaved best-of); the <=2% budget is ENFORCED
+    # (AssertionError), ledger headroom and per-site compile counts
+    # ride the JSON. HVD_BENCH_MEM=0 skips it.
+    mem = None
+    if os.environ.get("HVD_BENCH_MEM", "") != "0":
+        mem = _bench_mem(hvd, on_tpu)
 
     image_size = 224 if on_tpu else 64
     # Largest per-chip batch that compiles+runs wins MXU utilization; fall
@@ -2075,6 +2188,7 @@ def main():
         "mesh": mesh_leg,
         "ckpt": ckpt,
         "perf_attrib": perf_attrib,
+        "mem": mem,
         "metrics": metrics_snap,
     }))
     return 0

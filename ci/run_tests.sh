@@ -158,6 +158,20 @@ python -m pytest tests/test_profiling.py tests/test_costmodel.py \
     tests/test_hvd_perf.py -q -m "not slow"
 python tools/hvd_perf.py --check BENCH_r*.json
 
+echo "--- memory plane (fast fail: HBM ledger, recompile-storm ladder, resharding sentinel)"
+# The memory/compile observability plane (docs/memory.md) is the OOM
+# and recompile-storm early-warning system: one per-chip HBM ledger
+# attributing live bytes by component (hvdlint HVD020 keeps ad-hoc
+# probes out of the run paths), an EMA miss-rate ladder per jit site
+# that escalates event -> warning -> flight dump, and the GSPMD
+# sentinel that diffs compiled HLO collectives against the declared
+# spec tree. The suite is ledger math, plan-vs-measured accuracy on
+# the virtual mesh, and the storm/resharding drills; the selftest
+# round-trips plan math, the storm ladder and a deliberately
+# mis-specced jit on a 2-device CPU mesh with no network.
+python -m pytest tests/test_memory.py -q -m "not slow"
+python tools/hvd_mem.py --selftest
+
 echo "--- unit + integration tests (8-device virtual mesh)"
 # Sharded across CPU cores when pytest-xdist is present: the suite is
 # wall-clock-bound by subprocess spawns + compiles, and the files are

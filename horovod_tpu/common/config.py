@@ -343,6 +343,19 @@ ENV_REGISTRY = (
      "Framework log level (TRACE/DEBUG/INFO/WARNING/ERROR/FATAL)."),
     ("HOROVOD_LOG_TIMESTAMP", True, "0", "common/config.py",
      "Prefix log lines with timestamps."),
+    ("HOROVOD_MEM", True, "1", "utils/memory.py",
+     "Set 0 to disable the memory & compile observability plane (HBM "
+     "ledger gauges, jit-site compile tracking, recompile-storm "
+     "ladder, resharding sentinel reporting)."),
+    ("HOROVOD_MEM_STORM_DECAY", True, "0.8", "utils/memory.py",
+     "EMA decay of the per-site compile-miss rate the recompile-storm "
+     "detector maintains (closer to 1 = longer memory)."),
+    ("HOROVOD_MEM_STORM_EMA", True, "0.5", "utils/memory.py",
+     "Miss-rate EMA threshold above which an instrumented jit site is "
+     "declared in a recompile storm."),
+    ("HOROVOD_MEM_STORM_MIN", True, "3", "utils/memory.py",
+     "Minimum distinct compile misses at a site before the storm "
+     "ladder may fire (the first compile is always free)."),
     ("HOROVOD_MESH", False, None, "parallel/mesh.py",
      "Full data-plane mesh spec as comma-separated axis=size pairs "
      "(e.g. dp=2,tp=4; dp may be omitted and absorbs the remaining "
@@ -572,6 +585,11 @@ ENV_REGISTRY = (
     ("HVD_BENCH_LABEL", False, None, "bench.py",
      "Free-form run label stamped into the bench JSON provenance "
      "(shows up as the run name in tools/hvd_perf.py reports)."),
+    ("HVD_BENCH_MEM", False, None, "bench.py",
+     "Set 0 to skip the memory-plane overhead gate (HBM ledger + "
+     "compile tracking on vs off around the real eager LM step, "
+     "interleaved best-of; asserts <=2% overhead and records ledger "
+     "headroom + per-site compile counts)."),
     ("HVD_BENCH_MESH", False, None, "bench.py",
      "Set 0 to skip the named-mesh bench leg (tp=2 vs dp-only eager "
      "LM tokens/s/chip at equal global batch, plus the tp-sharded "

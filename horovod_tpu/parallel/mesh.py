@@ -229,6 +229,32 @@ def mesh_layout(mesh=None):
     return {a: int(s) for a, s in _resolve(mesh).shape.items()}
 
 
+def spec_shard_shape(shape, spec, mesh=None):
+    """Per-chip shard shape of ``shape`` under a PartitionSpec — pure
+    axis-size math, no arrays placed. This is what
+    ``NamedSharding.shard_shape`` computes for a committed array, made
+    available for *abstract* leaves so the memory plane's ledger and
+    pre-flight planner (utils/memory.py, docs/memory.md) attribute
+    bytes from a spec tree alone. Indivisible dims stay whole,
+    mirroring the replicate-don't-rag rule of ``kv_cache_spec``."""
+    if spec is None:
+        return tuple(shape)
+    sizes = mesh_layout(mesh) if not isinstance(mesh, dict) else mesh
+    entries = tuple(spec)
+    out = []
+    for i, dim in enumerate(shape):
+        part = entries[i] if i < len(entries) else None
+        if part is None:
+            out.append(dim)
+            continue
+        names = part if isinstance(part, (tuple, list)) else (part,)
+        div = 1
+        for name in names:
+            div *= int(sizes.get(name, 1))
+        out.append(dim // div if div and dim % div == 0 else dim)
+    return tuple(out)
+
+
 def named_sharding(spec, mesh=None):
     """The one sanctioned ``NamedSharding`` constructor (hvdlint HVD019).
 

@@ -314,24 +314,39 @@ class Router:
     def _should_shed(self, candidates, loads, now):
         """None = someone has headroom. Otherwise (reason,
         retry_after_s): every candidate is saturated — out of KV
-        blocks, or queued past ``HVD_ELASTIC_SHED_DEPTH`` — so
-        admission would only park the request behind a backlog it
-        cannot beat."""
+        blocks, forecast to stay out (the memory plane's OOM forecast,
+        docs/memory.md: the queued backlog's block claim exceeds the
+        WHOLE pool, so even a full drain of the active slots leaves
+        the cache short — a merely-negative ``predicted_free_blocks``
+        is a drainable backlog, not exhaustion), or queued past
+        ``HVD_ELASTIC_SHED_DEPTH`` — so admission would only park the
+        request behind a backlog it cannot beat."""
         if self._shed_depth <= 0 or not candidates:
             return None
         reasons = []
         for rid in candidates:
             snap = loads.get(rid) or {}
             free_blocks = snap.get("free_blocks")
+            predicted = snap.get("predicted_free_blocks")
+            total = snap.get("total_blocks")
             if free_blocks is not None and free_blocks <= 0:
                 reasons.append("kv_exhausted")
+            elif (predicted is not None and total is not None
+                  and free_blocks is not None
+                  and predicted <= free_blocks - total):
+                # queued claims >= total_blocks: backlog outgrows the
+                # pool itself, not just the currently-free slice
+                reasons.append("kv_forecast")
             elif (snap.get("queue_depth") or 0) >= self._shed_depth:
                 reasons.append("queue_depth")
             else:
                 return None
-        reason = ("kv_exhausted"
-                  if all(r == "kv_exhausted" for r in reasons)
-                  else "queue_depth")
+        if all(r == "kv_exhausted" for r in reasons):
+            reason = "kv_exhausted"
+        elif all(r in ("kv_exhausted", "kv_forecast") for r in reasons):
+            reason = "kv_forecast"
+        else:
+            reason = "queue_depth"
         return reason, self._retry_after(candidates, loads, now)
 
     def _retry_after(self, candidates, loads, now):

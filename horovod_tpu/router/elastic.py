@@ -282,9 +282,17 @@ class ElasticityController:
         depth = sum(s.get("queue_depth") or 0 for s in snaps)
         active = sum(s.get("active_slots") or 0 for s in snaps)
         free_slots = sum(s.get("free_slots") or 0 for s in snaps)
-        reported = [s for s in snaps if s.get("free_blocks") is not None]
+        # KV starvation prefers the memory plane's OOM forecast when a
+        # replica reports it (docs/memory.md): predicted_free_blocks
+        # discounts queued-but-unadmitted work, so pressure fires one
+        # queue-drain EARLIER than waiting for free_blocks to hit zero.
+        def _kv_headroom(s):
+            p = s.get("predicted_free_blocks")
+            return p if p is not None else s.get("free_blocks")
+
+        reported = [s for s in snaps if _kv_headroom(s) is not None]
         kv_starved = bool(reported) and all(
-            s["free_blocks"] <= 0 for s in reported)
+            _kv_headroom(s) <= 0 for s in reported)
         win = self._recent_window()
         ttft = win.ttft_p99() if win is not None and win.n else None
         pressure = (depth / len(live) >= self.up_depth or kv_starved or

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..common import config
 from ..common.exceptions import RanksLostError
+from ..utils import memory as hvd_memory
 from ..utils import metrics as hvd_metrics
 from ..utils import tracing as hvd_tracing
 from . import tracing as serve_tracing
@@ -131,6 +132,15 @@ class ServeEngine:
         self.kv = KVCache(cfg, num_slots, max_len=max_len,
                           block_size=kv_block, total_blocks=total_blocks,
                           mesh=mesh)
+        # Memory plane (docs/memory.md): state what this engine holds —
+        # the placed weight tree and the dense KV arrays — so the
+        # per-chip HBM ledger attributes serving bytes from tree
+        # metadata alone (device probes stay inside utils/memory.py,
+        # hvdlint HVD020).
+        if hvd_memory.enabled():
+            mem_ledger = hvd_memory.get_ledger()
+            mem_ledger.account_tree("params", params)
+            mem_ledger.account_kv(self.kv)
         self.scheduler = SlotScheduler(num_slots, policy=policy)
         self.queue = queue if queue is not None else AdmissionQueue()
         self._clock = clock
@@ -286,14 +296,22 @@ class ServeEngine:
         sub = self._subscriber
         work = sum(max(st.request.max_new_tokens - len(st.generated), 0)
                    for st in self._active.values())
-        if hasattr(self.queue, "queued_work_tokens"):
-            work += self.queue.queued_work_tokens()
+        queued_tokens = (self.queue.queued_work_tokens()
+                         if hasattr(self.queue, "queued_work_tokens")
+                         else 0)
+        work += queued_tokens
         snap = {
             "queue_depth": len(self.queue),
             "active_slots": len(self._active),
             "work_tokens": work,
             "free_slots": self.kv.num_slots - len(self._active),
             "free_blocks": ledger.total_blocks - ledger.blocks_in_use,
+            "total_blocks": ledger.total_blocks,
+            # OOM forecast (docs/memory.md): free blocks after the
+            # queue drains — the elasticity pressure signal and the
+            # router's kv_forecast shed read this field
+            "predicted_free_blocks": ledger.predicted_free_blocks(
+                queued_tokens),
             "generation": self._generation,
             "armed_generation": (getattr(sub, "armed_generation", None)
                                  if sub is not None else None),
@@ -301,6 +319,26 @@ class ServeEngine:
         if self._draining:
             snap["draining"] = True
         return snap
+
+    def resharding_report(self):
+        """GSPMD resharding sentinel over the decode step
+        (docs/memory.md): lower + compile ``_decode_jit`` at this
+        engine's real shapes and scan the optimized HLO for collectives
+        that gather a param leaf the spec tree declared sharded. Empty
+        on a clean spec tree (and always on an unsharded engine, where
+        nothing is declared sharded)."""
+        from ..models.transformer import param_specs
+        S = self.kv.num_slots
+        tokens = jnp.zeros(S, jnp.int32)
+        positions = jnp.zeros(S, jnp.int32)
+        temps = jnp.zeros(S, jnp.float32)
+        lowered = _decode_jit.lower(
+            self.cfg, self.params, tokens, positions, self.kv.k,
+            self.kv.v, temps, jax.random.PRNGKey(0))
+        hlo = lowered.compile().as_text()
+        return hvd_memory.scan_resharding(
+            hlo, self.params, param_specs(self.params), self.mesh,
+            site="serve_decode")
 
     # -- internals ------------------------------------------------------
 
@@ -340,6 +378,10 @@ class ServeEngine:
         self._params_by_gen[gen] = new_params
         self._generation = gen
         self._prune_params()
+        # re-state the params component: a swapped-in generation may
+        # differ in dtype/shape from the tree it replaces
+        if hvd_memory.enabled():
+            hvd_memory.get_ledger().account_tree("params", new_params)
         now = sub.clock()  # the subscriber's clock stamped rec
         d2l = max(rec.loaded_ts - rec.detect_ts, 0.0)
         l2a = max(rec.armed_ts - rec.loaded_ts, 0.0)
@@ -444,6 +486,11 @@ class ServeEngine:
         tokens[0, :prompt_len] = req.prompt
         rng = jax.random.fold_in(self._rng, self._step_count)
         self._step_count += 1
+        # compile observability: each distinct padded prompt length is
+        # a real prefill recompile; a churn of them is the storm the
+        # tracker names (docs/memory.md)
+        if hvd_memory.enabled():
+            hvd_memory.get_tracker().observe("serve_prefill", (tokens,))
         tok, pk, pv = _prefill_jit(
             self.cfg, self.params, jnp.asarray(tokens),
             jnp.int32(prompt_len - 1), jnp.float32(req.temperature), rng)
@@ -500,6 +547,11 @@ class ServeEngine:
                 temps[slot] = st.request.temperature
             rng = jax.random.fold_in(self._rng, self._step_count)
             self._step_count += 1
+            # decode is shape-static by construction: one miss at the
+            # first step, hits forever — a second miss here IS the bug
+            if hvd_memory.enabled():
+                hvd_memory.get_tracker().observe(
+                    "serve_decode", (tokens, positions, temps))
             nxt, self.kv.k, self.kv.v = _decode_jit(
                 self.cfg, self._params_by_gen[gen], jnp.asarray(tokens),
                 jnp.asarray(positions), self.kv.k, self.kv.v,

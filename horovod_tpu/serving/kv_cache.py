@@ -127,6 +127,19 @@ class BlockLedger:
         del self._lengths[slot]
         self._free_slots.append(slot)
 
+    def predicted_free_blocks(self, queued_tokens):
+        """OOM forecast (docs/memory.md): free blocks AFTER the queue
+        drains — free minus what ``queued_tokens`` of not-yet-admitted
+        work will claim. Active slots already hold their whole-life
+        reservation (alloc_at reserves prompt + max_new at admission),
+        so the only future claim left is the queue. ≤0 means the next
+        admissions will exhaust the cache: the elasticity pressure
+        signal and the router's ``kv_forecast`` shed read this."""
+        free = self.total_blocks - self.blocks_in_use
+        if not queued_tokens or queued_tokens <= 0:
+            return free
+        return free - math.ceil(queued_tokens / self.block_size)
+
 
 class KVCache:
     """Dense per-slot K/V device arrays plus their ledger.

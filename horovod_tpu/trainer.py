@@ -30,6 +30,7 @@ from . import optim
 from .parallel import mesh as mesh_lib
 from .ops.compression import Compression
 from .utils import checkpoint as hvd_checkpoint
+from .utils import memory as hvd_memory
 from .utils import metrics as hvd_metrics
 from .utils import tracing as hvd_tracing
 
@@ -67,6 +68,14 @@ def instrument_step(step_fn, tokens_per_step=None, name="train",
         emit a ``perf_attrib_error`` event and never break the step;
         the steady-state overhead is bench-gated ≤2%
         (``HVD_BENCH_PERF``).
+
+    The memory plane (docs/memory.md, default-on via HVD_MEM) rides the
+    same wrapper: every call reports its abstract-shape key to the
+    compile tracker under site ``train:<name>`` (the recompile-storm
+    signal), and ``hvd_step_peak_hbm_bytes`` tracks the allocator's
+    peak next to ``hvd_mfu`` — nulled on CPU the same way, since CPU
+    backends expose no allocator stats. Overhead is bench-gated ≤2%
+    (``HVD_BENCH_MEM``).
     """
     reg = hvd_metrics.get_registry()
     if not reg.enabled:
@@ -97,6 +106,15 @@ def instrument_step(step_fn, tokens_per_step=None, name="train",
         "hvd_mfu", "Model FLOPs utilization of the most recent step "
         "(flops_per_step / peak / step seconds).",
         labels=("loop",)) if flops_per_step and spec else None
+    # Memory plane (docs/memory.md): peak allocator bytes next to the
+    # MFU gauge, nulled the same way on CPU — backends without
+    # allocator stats (step_peak_bytes() None) never create the gauge.
+    peak_hbm = reg.gauge(
+        "hvd_step_peak_hbm_bytes",
+        "Peak allocated device bytes on this chip as of the most "
+        "recent step (memory plane; absent off-TPU).",
+        labels=("loop",)) if hvd_memory.enabled() \
+        and hvd_memory.step_peak_bytes() is not None else None
     if attrib_every:
         busy = reg.gauge(
             "hvd_step_device_busy_frac",
@@ -168,6 +186,12 @@ def instrument_step(step_fn, tokens_per_step=None, name="train",
             except Exception:
                 reg.event("perf_attrib_error", phase="start")
                 pdir = None
+        # Compile observability (docs/memory.md): this call's abstract-
+        # shape key is what the jit cache hits or misses on; a churning
+        # key here is the recompile storm the tracker escalates.
+        if hvd_memory.enabled():
+            hvd_memory.get_tracker().observe(f"train:{name}",
+                                             (args, kwargs))
         t0 = time.perf_counter()
         # step span: the root every per-tensor span of this step hangs
         # under in the postmortem timeline (stage="step", one per call)
@@ -192,6 +216,10 @@ def instrument_step(step_fn, tokens_per_step=None, name="train",
         if mfu is not None and dt > 0:
             mfu.labels(loop=name).set(
                 flops_per_step / (spec.peak_flops * dt))
+        if peak_hbm is not None and hvd_memory.enabled():
+            pb = hvd_memory.step_peak_bytes()
+            if pb is not None:
+                peak_hbm.labels(loop=name).set(pb)
         return out
 
     return wrapped

@@ -38,17 +38,19 @@ import math
 
 class ChipSpec:
     """Nominal per-chip roofline parameters (bf16 dense matmul peak,
-    HBM and ICI bandwidth in bytes/s)."""
+    HBM and ICI bandwidth in bytes/s, HBM capacity in bytes — the
+    memory plane's budget denominator; None when unknown)."""
 
     __slots__ = ("kind", "peak_flops", "hbm_bytes_per_s",
-                 "ici_bytes_per_s")
+                 "ici_bytes_per_s", "hbm_capacity_bytes")
 
     def __init__(self, kind, peak_flops, hbm_bytes_per_s,
-                 ici_bytes_per_s):
+                 ici_bytes_per_s, hbm_capacity_bytes=None):
         self.kind = kind
         self.peak_flops = peak_flops
         self.hbm_bytes_per_s = hbm_bytes_per_s
         self.ici_bytes_per_s = ici_bytes_per_s
+        self.hbm_capacity_bytes = hbm_capacity_bytes
 
     @property
     def ridge_flops_per_byte(self):
@@ -58,19 +60,23 @@ class ChipSpec:
     def as_dict(self):
         return {"kind": self.kind, "peak_flops": self.peak_flops,
                 "hbm_bytes_per_s": self.hbm_bytes_per_s,
-                "ici_bytes_per_s": self.ici_bytes_per_s}
+                "ici_bytes_per_s": self.ici_bytes_per_s,
+                "hbm_capacity_bytes": self.hbm_capacity_bytes}
 
+
+_GiB = 2 ** 30
 
 # Nominal datasheet numbers by device_kind prefix; longest prefix wins
 # ("TPU v5 lite" before "TPU v5"). The "cpu" row exists so the whole
 # attribution path exercises on the CPU CI — the numbers are a stand-in
-# order of magnitude, not a measurement.
+# order of magnitude, not a measurement (the 4 GiB "capacity" bounds
+# the CI smoke ledger, it is not host RAM).
 CHIP_SPECS = (
-    ChipSpec("TPU v5 lite", 197e12, 819e9, 200e9),   # v5e
-    ChipSpec("TPU v5", 459e12, 2765e9, 600e9),       # v5p
-    ChipSpec("TPU v4", 275e12, 1228e9, 268e9),
-    ChipSpec("TPU v6", 918e12, 1640e9, 448e9),       # trillium
-    ChipSpec("cpu", 200e9, 50e9, 10e9),
+    ChipSpec("TPU v5 lite", 197e12, 819e9, 200e9, 16 * _GiB),   # v5e
+    ChipSpec("TPU v5", 459e12, 2765e9, 600e9, 95 * _GiB),       # v5p
+    ChipSpec("TPU v4", 275e12, 1228e9, 268e9, 32 * _GiB),
+    ChipSpec("TPU v6", 918e12, 1640e9, 448e9, 32 * _GiB),       # trillium
+    ChipSpec("cpu", 200e9, 50e9, 10e9, 4 * _GiB),
 )
 
 
@@ -172,6 +178,36 @@ def analytic_lm_costs(cfg, seq, batch_per_chip, n_chips=1,
             "wire_bytes": 2.0 * p_matmul * wire_bytes_per_param * ring,
         },
     }
+
+
+def lm_activation_bytes(cfg, seq, batch_per_chip, dtype_bytes=None):
+    """Per-chip LIVE activation bytes for one training step of the
+    transformer LM — the memory plane's "activations" component
+    (docs/memory.md), not a traffic figure.
+
+    The model counts what autodiff keeps resident for backward, per
+    token per layer: the two LN outputs + attention input/output
+    (≈4·d), the qkv projections (3·d), and the gated MLP's gate/up/down
+    intermediates (2·d_ff + d_ff ≈ 3·d_ff) — ≈(8·d + 3·d_ff)·bytes —
+    plus the residual stream once and the [B,T,vocab] logits (fp32 when
+    ``cfg.logits_fp32``). Flash/remat change the constant, not the
+    shape; this is a planning estimate good to the tens of percent the
+    ``hvd_mem --plan`` fit verdict needs, and the SAME formula feeds
+    both the plan and the measured ledger so the two stay comparable.
+    """
+    if dtype_bytes is None:
+        try:
+            import numpy as np
+            dtype_bytes = np.dtype(cfg.dtype).itemsize
+        # hvdlint: disable=HVD006(exotic dtypes fall back to the bf16 default; the estimate stays an estimate)
+        except Exception:
+            dtype_bytes = 2
+    tokens = batch_per_chip * seq
+    per_layer = (8 * cfg.d_model + 3 * cfg.d_ff) * dtype_bytes
+    logits_bytes = 4 if getattr(cfg, "logits_fp32", True) else dtype_bytes
+    return int(tokens * (cfg.num_layers * per_layer
+                         + cfg.d_model * dtype_bytes
+                         + cfg.vocab_size * logits_bytes))
 
 
 def roofline(costs, spec):

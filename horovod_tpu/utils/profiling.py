@@ -323,6 +323,13 @@ def profile_decomposition(trace, wall_ms=None, steps=1,
     overlap = overlap_accounting(summary, classes=classes, steps=steps)
     if overlap is not None:
         out["overlap"] = overlap
+    # Memory plane (docs/memory.md): stamp the allocator peak alongside
+    # the time decomposition, so a capture answers "was the slow step
+    # also the big step" without a second tool. None off-TPU.
+    from . import memory as memory_mod
+    peak = memory_mod.step_peak_bytes()
+    if peak is not None:
+        out["peak_hbm_bytes"] = peak
     return out
 
 

@@ -323,6 +323,11 @@ class Tracer:
             cycles = list(self._cycles)
             dropped = self._spans_dropped
         reg = metrics_mod.get_registry()
+        # Memory-plane section (docs/memory.md): HBM ledger components +
+        # per-site compile summary, so an OOM/recompile postmortem reads
+        # from the same dump as the spans. flight_section() never raises
+        # and is None until something has been accounted.
+        from . import memory as memory_mod
         return {
             "version": FLIGHT_VERSION,
             "rank": self.rank,
@@ -334,6 +339,7 @@ class Tracer:
             "cycles": cycles,
             "spans_dropped": dropped,
             "events": reg.events(),
+            "memory": memory_mod.flight_section(),
         }
 
     def dump(self, reason="", path=None):

@@ -1478,11 +1478,95 @@ def test_walk_excludes_pycache_and_native(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD020 — ad-hoc memory probe outside the memory plane
+# ---------------------------------------------------------------------------
+
+def test_hvd020_triggers_on_device_memory_stats(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mem_path
+        import jax
+
+        def headroom():
+            return jax.devices()[0].memory_stats()
+        """)
+    assert [f.rule for f in live(found)] == ["HVD020"]
+
+
+def test_hvd020_triggers_on_live_arrays_and_memory_analysis(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mem_path
+        import jax
+
+        def audit(compiled):
+            n = sum(a.nbytes for a in jax.live_arrays())
+            return n, compiled.memory_analysis()
+        """)
+    assert [f.rule for f in live(found)] == ["HVD020", "HVD020"]
+
+
+def test_hvd020_memory_plane_wrappers_are_sanctioned(tmp_path):
+    # the fix the rule points at: probes routed through utils/memory.py
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mem_path
+        from horovod_tpu.utils import memory as hvd_memory
+
+        def headroom():
+            hvd_memory.get_ledger().account_tree("params", {})
+            return hvd_memory.step_peak_bytes()
+        """)
+    assert live(found, "HVD020") == []
+
+
+def test_hvd020_scoped_to_trainer_serving_ops(tmp_path):
+    # no role marker, not under trainer/serving/ops: out of scope
+    found = lint_source(tmp_path, """\
+        import jax
+
+        def headroom():
+            return jax.devices()[0].memory_stats()
+        """)
+    assert live(found, "HVD020") == []
+
+
+def test_hvd020_fires_under_serving_but_not_in_memory_py(tmp_path):
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    src = ("import jax\n\n"
+           "def headroom():\n"
+           "    return jax.devices()[0].memory_stats()\n")
+    serve = tmp_path / "horovod_tpu" / "serving"
+    serve.mkdir(parents=True)
+    (serve / "probe.py").write_text(src)
+    plane = tmp_path / "horovod_tpu" / "utils"
+    plane.mkdir(parents=True)
+    (plane / "memory.py").write_text(src)
+    findings, _ = analyze_paths(
+        [str(serve / "probe.py"), str(plane / "memory.py")],
+        env_registry_path=str(reg))
+    assert [(f.rule, "serving" in f.file) for f in live(findings)] == \
+        [("HVD020", True)]
+
+
+def test_hvd020_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=mem_path
+        import jax
+
+        def debug_dump():
+            # hvdlint: disable=HVD020(one-shot debug CLI, not a run path)
+            return jax.devices()[0].memory_stats()
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD020"]
+
+
+# ---------------------------------------------------------------------------
 # rule catalog + CLI + end-to-end gate
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 20)]
+    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 21)]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

@@ -480,6 +480,30 @@ class TestSatelliteInstrumentation:
         wrapped(1)
         assert "hvd_mfu" not in reg.snapshot()["metrics"]
 
+    def test_instrument_step_peak_hbm_gauge(self, reg, monkeypatch):
+        # memory plane (docs/memory.md): allocator-backed peak bytes
+        # next to the MFU gauge; CPU has no allocator stats, so the
+        # probe is faked the way a TPU backend would answer
+        from horovod_tpu import trainer
+        from horovod_tpu.utils import memory as hvd_memory
+        monkeypatch.setattr(hvd_memory, "step_peak_bytes",
+                            lambda device=None: 12345)
+        wrapped = trainer.instrument_step(lambda x: x, name="unit")
+        wrapped(1)
+        m = reg.snapshot()["metrics"]
+        (peak,) = m["hvd_step_peak_hbm_bytes"]["values"]
+        assert peak["labels"] == {"loop": "unit"}
+        assert peak["value"] == 12345
+
+    def test_instrument_step_no_peak_gauge_on_cpu(self, reg):
+        # the CPU-null arm, mirroring the MFU gauge: no allocator
+        # stats → the gauge is never created, not created-as-zero
+        from horovod_tpu import trainer
+        wrapped = trainer.instrument_step(lambda x: x, name="unit")
+        wrapped(1)
+        assert "hvd_step_peak_hbm_bytes" not in \
+            reg.snapshot()["metrics"]
+
     def test_instrument_step_periodic_attribution(self, reg):
         import jax
         import jax.numpy as jnp

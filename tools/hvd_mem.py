@@ -1,0 +1,268 @@
+"""hvd_mem: pre-flight HBM planning and memory-plane selftest.
+
+Front door for the memory & compile observability plane
+(horovod_tpu/utils/memory.py, docs/memory.md):
+
+  * ``--plan``: the pre-flight estimator — "does this model fit at
+    dp=2,tp=4 on v5e?" answered from pure math (abstract param tree +
+    declared specs + the costmodel ChipSpec HBM table), no devices
+    touched. Prints the per-chip component table and a fits/overflow
+    verdict; exits non-zero on overflow so launch scripts can gate.
+  * ``--flight dump.json``: print the ``memory`` section a flight dump
+    carries (HBM ledger snapshot + per-site compile summary) — the
+    postmortem view of where the bytes went when a run died.
+  * ``--selftest``: CI smoke of the whole plane on 2 virtual CPU
+    devices — planner math, ledger attribution round-trip, the
+    recompile-storm ladder, and the GSPMD resharding drill (a
+    deliberately mis-specced jit must be named; a clean one must not).
+
+Usage:
+    python tools/hvd_mem.py --plan --model gpt2_small_tpu \
+        --dp 2 --tp 4 --chip v5e [--batch-per-chip 8] [--seq 1024] \
+        [--optimizer adam] [--kv-slots 8] [--kv-max-len 1024]
+    python tools/hvd_mem.py --flight /tmp/hvd-flight/flight-rank0.json
+    python tools/hvd_mem.py --selftest
+
+Runbook: docs/memory.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+try:
+    from horovod_tpu.utils import memory as hvd_memory
+except ImportError:  # run straight from a checkout: tools/ is no package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from horovod_tpu.utils import memory as hvd_memory
+
+MODELS = ("tiny", "gpt2_small", "gpt2_small_tpu", "llama_1b")
+
+# Friendly CLI names → the device_kind prefixes the ChipSpec table
+# matches on. Unknown strings pass through, so a literal device_kind
+# ("TPU v5 lite") works too.
+CHIP_ALIASES = {"v5e": "TPU v5 lite", "v5litepod": "TPU v5 lite",
+                "v5p": "TPU v5", "v5": "TPU v5", "v4": "TPU v4",
+                "v6e": "TPU v6", "v6": "TPU v6", "trillium": "TPU v6"}
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    sign = "-" if n < 0 else ""
+    n = abs(float(n))
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return (f"{sign}{n:.0f} {unit}" if unit == "B"
+                    else f"{sign}{n:.2f} {unit}")
+        n /= 1024
+    return None  # pragma: no cover - loop always returns
+
+
+# -- --plan ------------------------------------------------------------------
+
+def cmd_plan(args):
+    from horovod_tpu.models import transformer as tr
+
+    kw = {}
+    if args.dtype:
+        kw["dtype"] = args.dtype
+    cfg = getattr(tr.TransformerConfig, args.model)(**kw)
+    chip = CHIP_ALIASES.get((args.chip or "").lower(), args.chip)
+    plan = hvd_memory.plan_memory(
+        cfg, dp=args.dp, tp=args.tp, sp=args.sp,
+        batch_per_chip=args.batch_per_chip, seq=args.seq,
+        chip=chip, optimizer=args.optimizer,
+        kv_slots=args.kv_slots, kv_max_len=args.kv_max_len)
+    if args.json:
+        print(json.dumps(plan, indent=2, sort_keys=True))
+    else:
+        layout = plan["layout"]
+        print(f"hvd_mem plan: {args.model} @ dp={layout['dp']} "
+              f"tp={layout['tp']} sp={layout['sp']}, "
+              f"batch/chip={plan['batch_per_chip']}, seq={plan['seq']}"
+              + (f", chip={plan['chip']}" if plan["chip"] else ""))
+        for component in hvd_memory.COMPONENTS:
+            if component in plan["components"]:
+                print(f"  {component:<12} "
+                      f"{_fmt_bytes(plan['components'][component]):>12}")
+        print(f"  {'total':<12} {_fmt_bytes(plan['total_bytes']):>12}")
+        if plan["capacity_bytes"] is not None:
+            print(f"  {'capacity':<12} "
+                  f"{_fmt_bytes(plan['capacity_bytes']):>12}")
+            print(f"  {'headroom':<12} "
+                  f"{_fmt_bytes(plan['headroom_bytes']):>12}")
+            print("  verdict: " + ("FITS" if plan["fits"]
+                                   else "DOES NOT FIT"))
+        else:
+            print("  verdict: no chip given (--chip v5e|v5|v4|v6e) — "
+                  "no capacity to compare against")
+    # overflow is exit 1 so launch scripts can gate on the pre-flight
+    return 0 if plan["fits"] is not False else 1
+
+
+# -- --flight ----------------------------------------------------------------
+
+def cmd_flight(path):
+    with open(path) as f:
+        dump = json.load(f)
+    section = dump.get("memory")
+    if not section:
+        print(f"{path}: no memory section (plane disabled, or the dump "
+              f"predates docs/memory.md)")
+        return 1
+    hbm = section.get("hbm")
+    if hbm:
+        print(f"{path}: HBM ledger")
+        for component, nbytes in sorted(
+                (hbm.get("components") or {}).items()):
+            print(f"  {component:<12} {_fmt_bytes(nbytes):>12}")
+        print(f"  {'total':<12} {_fmt_bytes(hbm.get('total_bytes')):>12}")
+        if hbm.get("capacity_bytes") is not None:
+            print(f"  {'headroom':<12} "
+                  f"{_fmt_bytes(hbm.get('headroom_bytes')):>12}")
+    compile_summary = section.get("compile")
+    if compile_summary:
+        print("compile sites:")
+        for site, entry in sorted(compile_summary.items()):
+            storm = "  STORMING" if entry.get("storming") else ""
+            print(f"  {site:<24} hits={entry.get('hits', 0)} "
+                  f"misses={entry.get('misses', 0)}{storm}")
+            if entry.get("storming") and entry.get("last_key"):
+                print(f"    last missed key: {entry['last_key']}")
+    return 0
+
+
+# -- --selftest --------------------------------------------------------------
+
+def selftest():
+    """One pass over every plane surface on 2 virtual CPU devices.
+
+    Must run before any jax backend exists: the virtual-device flag
+    only takes effect at backend creation (same trick as
+    tests/conftest.py — jax's backend is lazy, so setting the env here,
+    before the first device call, is early enough even though jax was
+    imported at module load).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == 2, (
+        f"selftest needs 2 virtual devices, got {len(jax.devices())} — "
+        "was a jax backend created before hvd_mem ran?")
+
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.parallel import mesh as mesh_lib
+
+    # 1. planner math: tp=2 must halve the param bytes the specs shard
+    cfg = tr.TransformerConfig.tiny()
+    plan1 = hvd_memory.plan_memory(cfg, dp=1, tp=1, chip="cpu",
+                                   batch_per_chip=2, seq=64)
+    plan2 = hvd_memory.plan_memory(cfg, dp=1, tp=2, chip="cpu",
+                                   batch_per_chip=2, seq=64)
+    assert plan1["components"]["params"] > 0
+    assert plan2["components"]["params"] < plan1["components"]["params"]
+    assert plan1["capacity_bytes"] is not None and plan1["fits"] is True
+
+    # 2. ledger attribution round-trip against hand math
+    hvd_memory.reset(enabled=True)
+    ledger = hvd_memory.get_ledger()
+    w = jnp.zeros((16, 32), jnp.float32)
+    ledger.account_tree("params", {"w": w})
+    snap = ledger.snapshot()
+    assert snap["components"]["params"] == 16 * 32 * 4, snap
+    assert snap["total_bytes"] == 16 * 32 * 4
+
+    # 3. recompile-storm ladder: distinct keys every call must escalate
+    tracker = hvd_memory.CompileTracker(decay=0.5, threshold=0.4,
+                                        min_misses=3)
+    for n in range(1, 7):
+        tracker.observe("selftest:storm", (jnp.zeros((n,)),))
+    summary = tracker.site_summary()["selftest:storm"]
+    assert summary["storming"], summary
+    assert summary["misses"] == 6, summary
+    # and a stable site must not: same key every call
+    for _ in range(6):
+        tracker.observe("selftest:stable", (jnp.zeros((8,)),))
+    assert not tracker.site_summary()["selftest:stable"]["storming"]
+
+    # 4. resharding drill: a jit that gathers a declared-sharded param
+    #    must be named; the clean spec must stay silent
+    mesh = mesh_lib.build_mesh(tp=2)
+    params = {"w": jax.device_put(
+        jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16),
+        NamedSharding(mesh, P("tp", None)))}
+    spec_tree = {"w": P("tp", None)}
+    bad = jax.jit(lambda w: w * 2.0,
+                  in_shardings=NamedSharding(mesh, P("tp", None)),
+                  out_shardings=NamedSharding(mesh, P()))
+    findings = hvd_memory.scan_jit_resharding(
+        bad, (params["w"],), params, spec_tree, mesh,
+        site="selftest:bad")
+    assert len(findings) == 1, findings
+    assert findings[0]["leaf"] == "['w']" and findings[0]["axis"] == "tp", \
+        findings
+    clean = jax.jit(lambda w: w * 2.0,
+                    in_shardings=NamedSharding(mesh, P("tp", None)),
+                    out_shardings=NamedSharding(mesh, P("tp", None)))
+    assert hvd_memory.scan_jit_resharding(
+        clean, (params["w"],), params, spec_tree, mesh,
+        site="selftest:clean") == []
+
+    hvd_memory.reset()
+    print("hvd_mem --selftest: ok (plan math, ledger round-trip, "
+          "storm ladder, resharding drill)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Pre-flight HBM planning and memory-plane selftest "
+                    "(docs/memory.md)")
+    ap.add_argument("--plan", action="store_true",
+                    help="print the per-chip HBM estimate for a layout")
+    ap.add_argument("--model", choices=MODELS, default="gpt2_small_tpu")
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--batch-per-chip", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=None,
+                    help="sequence length (default: min(max_seq_len, 128))")
+    ap.add_argument("--chip", default=None,
+                    help="ChipSpec kind for capacity (v5e, v5, v4, v6e)")
+    ap.add_argument("--optimizer", default="adam",
+                    choices=("adam", "adamw", "sgd", "none"))
+    ap.add_argument("--kv-slots", type=int, default=0,
+                    help="serving: KV-cache slots to plan for")
+    ap.add_argument("--kv-max-len", type=int, default=0,
+                    help="serving: KV-cache max length per slot")
+    ap.add_argument("--dtype", default=None,
+                    help="override the config dtype (e.g. float32)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable plan output")
+    ap.add_argument("--flight", metavar="DUMP",
+                    help="print the memory section of a flight dump")
+    ap.add_argument("--selftest", action="store_true",
+                    help="CI smoke: exercise the whole plane on CPU")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.flight:
+        return cmd_flight(args.flight)
+    if args.plan:
+        return cmd_plan(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

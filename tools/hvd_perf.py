@@ -92,6 +92,8 @@ LEGS = (
     Leg("mesh_serve_kv_per_chip_ratio",
         ("mesh", "serve", "kv_per_chip_bytes_ratio"),
         context_paths=(("mesh", "devices"),)),
+    Leg("mem_overhead_pct", ("mem", "overhead_pct"),
+        higher_better=False),
     Leg("overlap_frac", ("overlap", "overlap_frac"),
         context_paths=_OVERLAP_CTX),
     Leg("overlap_exposed_comm_ms", ("overlap", "exposed_comm_ms_on"),

@@ -401,6 +401,44 @@ def analyze(dumps):
             f"router: shed {len(sheds)} request(s) at admission "
             f"({dict(by_reason)}) — every replica saturated")
 
+    # 9. memory plane (docs/memory.md): recompile storms name the jit
+    # site whose cache is churning (a dump tagged recompile_storm was
+    # written BY the storm ladder); resharding findings name the param
+    # leaf GSPMD gathers every step; the dump's own "memory" section
+    # says where the per-chip bytes went when the run died.
+    recompile_storms, resharding_findings = [], []
+    memory_by_rank = {}
+    for d in dumps:
+        for e in d.get("events", []):
+            kind = e.get("event")
+            if kind == "recompile_storm":
+                recompile_storms.append({"dump_rank": _rank_of(d), **e})
+                reasons.append(
+                    f"memory: recompile storm at jit site "
+                    f"'{e.get('site')}' ({e.get('misses')} distinct "
+                    f"abstract-shape keys, last missed {e.get('key')})")
+            elif kind == "resharding_finding":
+                resharding_findings.append(
+                    {"dump_rank": _rank_of(d), **e})
+                reasons.append(
+                    f"memory: GSPMD reshards param {e.get('leaf')} "
+                    f"({e.get('op')} over axis {e.get('axis')}) at site "
+                    f"'{e.get('site')}' — the declared spec is undone "
+                    f"every step")
+        mem = d.get("memory")
+        if mem:
+            hbm = mem.get("hbm") or {}
+            memory_by_rank[_rank_of(d)] = mem
+            headroom = hbm.get("headroom_bytes")
+            capacity = hbm.get("capacity_bytes")
+            if (headroom is not None and capacity
+                    and headroom < 0.1 * capacity):
+                reasons.append(
+                    f"memory: rank {_rank_of(d)} dumped with only "
+                    f"{headroom} B HBM headroom of {capacity} B "
+                    f"capacity — OOM territory "
+                    f"(components: {hbm.get('components')})")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -456,6 +494,9 @@ def analyze(dumps):
         "drain_events": drain_events,
         "breaker_transitions": breaker_transitions,
         "sheds": sheds,
+        "recompile_storms": recompile_storms,
+        "resharding_findings": resharding_findings,
+        "memory_by_rank": memory_by_rank,
     }
 
 
@@ -546,6 +587,14 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
         lines.append(f"  sheds          : {len(verdict['sheds'])} "
                      f"(first retry-after "
                      f"{verdict['sheds'][0].get('retry_after_s')}s)")
+    if verdict.get("recompile_storms"):
+        storms = [(e.get("site"), e.get("misses"))
+                  for e in verdict["recompile_storms"]]
+        lines.append(f"  recompile storms: {storms}")
+    if verdict.get("resharding_findings"):
+        finds = [(e.get("leaf"), e.get("op"), e.get("axis"))
+                 for e in verdict["resharding_findings"]]
+        lines.append(f"  resharding     : {finds}")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -576,6 +625,23 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
             note = f"  (never enqueued on {absent})" if absent else ""
             lines.append(f"  {tensor}: open on ranks {who}{note}")
 
+    if verdict.get("memory_by_rank"):
+        lines.append("")
+        lines.append("-- memory at dump time " + "-" * 49)
+        for rank, mem in sorted(verdict["memory_by_rank"].items()):
+            hbm = mem.get("hbm") or {}
+            comp = ", ".join(f"{k}={v:,}" for k, v in sorted(
+                (hbm.get("components") or {}).items()))
+            lines.append(f"  rank {rank}: {comp or '(no ledger)'}")
+            if hbm.get("headroom_bytes") is not None:
+                lines.append(f"    headroom {hbm['headroom_bytes']:,} B "
+                             f"of {hbm.get('capacity_bytes'):,} B")
+            for site, entry in sorted((mem.get("compile") or {}).items()):
+                storm = "  STORMING" if entry.get("storming") else ""
+                lines.append(
+                    f"    compile {site}: hits={entry.get('hits', 0)} "
+                    f"misses={entry.get('misses', 0)}{storm}")
+
     lines.append("")
     lines.append("-- last negotiation cycles per rank " + "-" * 36)
     for rank in sorted(cycles_by_rank):
@@ -599,7 +665,8 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
                                   "ckpt_preempt", "ckpt_emergency_exit",
                                   "route_replica_lost", "route_reroute",
                                   "route_canary_begin", "route_promote",
-                                  "route_rollback"):
+                                  "route_rollback", "recompile_storm",
+                                  "resharding_finding"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
@@ -656,7 +723,8 @@ def chrome_trace(dumps, stitched):
                         "fleet_refuse", "ckpt_preempt",
                         "ckpt_emergency_exit", "route_replica_lost",
                         "route_reroute", "route_canary_begin",
-                        "route_promote", "route_rollback"):
+                        "route_promote", "route_rollback",
+                        "recompile_storm", "resharding_finding"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

@@ -377,6 +377,53 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
                          f"{100.0 * wd:+.1f}% vs its EMA")
                 lines.append(c(YELLOW, dline) if wd > 0.1 else dline)
 
+    # memory plane: where the per-chip HBM bytes went, compile-cache
+    # health per jit site, and the GSPMD resharding sentinel's verdict
+    # (horovod_tpu/utils/memory.py; docs/memory.md)
+    hbm = _by_label(snap, "hvd_hbm_bytes", "component")
+    compile_hits, compile_misses = {}, {}
+    for v in _values(snap, "hvd_compile_total"):
+        lbl = v.get("labels", {})
+        d = (compile_misses if lbl.get("outcome") == "miss"
+             else compile_hits)
+        site = lbl.get("site", "")
+        d[site] = d.get(site, 0) + v.get("value", 0.0)
+    if hbm or compile_hits or compile_misses:
+        lines.append(c(BOLD, "  memory"))
+        if hbm:
+            lines.append("    hbm           " + "  ".join(
+                f"{k}={_fmt_bytes(v)}"
+                for k, v in sorted(hbm.items(), key=lambda kv: -kv[1])))
+            cap = _total(snap, "hvd_hbm_capacity_bytes")
+            headroom = _total(snap, "hvd_hbm_headroom_bytes")
+            if cap:
+                head_line = (f"    headroom      "
+                             f"{_fmt_bytes(headroom):>12}   of "
+                             f"{_fmt_bytes(cap)} capacity")
+                peak = _by_label(snap, "hvd_step_peak_hbm_bytes", "loop")
+                if peak:
+                    head_line += "   step peak " + "  ".join(
+                        f"{k}={_fmt_bytes(v)}"
+                        for k, v in sorted(peak.items()))
+                # <10% headroom is the OOM red zone an operator must see
+                lines.append(c(RED, head_line)
+                             if headroom < 0.1 * cap else head_line)
+        storms = _by_label(snap, "hvd_recompile_storms_total", "site")
+        for site in sorted(set(compile_hits) | set(compile_misses)):
+            sline = (f"    {site:<13} "
+                     f"hits {int(compile_hits.get(site, 0)):>8,}   "
+                     f"misses {int(compile_misses.get(site, 0)):>4,}")
+            if storms.get(site):
+                sline += f"   storms {int(storms[site])}"
+            lines.append(c(YELLOW, sline) if storms.get(site) else sline)
+        reshard = _by_label(snap, "hvd_resharding_findings_total",
+                            "site")
+        if reshard:
+            # any finding means GSPMD is gathering a declared-sharded
+            # param every step — never routine
+            lines.append(c(RED, "    resharding    " + "  ".join(
+                f"{k}={int(v)}" for k, v in sorted(reshard.items()))))
+
     # checkpoint plane: durability at a glance — how stale is the last
     # commit, and is the async writer keeping up (drops) or corrupting
     # (restore outcomes). (horovod_tpu/utils/checkpoint.py;
@@ -773,6 +820,24 @@ def canned_snapshot():
               labels=("loop",)).labels(loop="train").set(6.3)
     reg.gauge("hvd_step_overlap_frac", "g",
               labels=("loop",)).labels(loop="train").set(0.65)
+    hb = reg.gauge("hvd_hbm_bytes", "g", labels=("component",))
+    for component, nbytes in (("params", 2 << 30), ("opt_state", 4 << 30),
+                              ("grads", 2 << 30), ("kv_cache", 1 << 30),
+                              ("activations", 3 << 30)):
+        hb.labels(component=component).set(nbytes)
+    reg.gauge("hvd_hbm_capacity_bytes", "g").set(16 << 30)
+    reg.gauge("hvd_hbm_headroom_bytes", "g").set(4 << 30)
+    reg.gauge("hvd_step_peak_hbm_bytes", "g",
+              labels=("loop",)).labels(loop="train").set(13 << 30)
+    ct = reg.counter("hvd_compile_total", "c", labels=("site", "outcome"))
+    ct.labels(site="train:train", outcome="hit").inc(4099)
+    ct.labels(site="train:train", outcome="miss").inc(1)
+    ct.labels(site="serve_prefill", outcome="hit").inc(1700)
+    ct.labels(site="serve_prefill", outcome="miss").inc(140)
+    reg.counter("hvd_recompile_storms_total", "c",
+                labels=("site",)).labels(site="serve_prefill").inc()
+    reg.counter("hvd_resharding_findings_total", "c",
+                labels=("site",)).labels(site="gspmd_step").inc()
     cs = reg.counter("hvd_ckpt_saves_total", "c", labels=("kind",))
     cs.labels(kind="async").inc(41)
     cs.labels(kind="emergency").inc(1)
@@ -895,6 +960,8 @@ def canned_snapshot():
     reg.event("serve_failover", lost_ranks=[1],
               inflight=["req-9810", "req-9811"])
     reg.event("slow_decode_tick", active=6, dur_ms=312.0)
+    reg.event("recompile_storm", site="serve_prefill", misses=140,
+              key="int32[1,96] int32[1]")
     reg.event("stall", tensor="grad/dense_7", missing_ranks=[3],
               waited_s=61.2, trace_id="r1.42")
     reg.event("chaos_injection", fault="drop_response",

@@ -1492,6 +1492,55 @@ def check_adhoc_sharding(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD020 — ad-hoc memory probe outside the memory plane
+# ---------------------------------------------------------------------------
+
+# allocator/live-set introspection calls: device.memory_stats(),
+# jax.live_arrays(), compiled.memory_analysis(). The memory plane
+# (utils/memory.py) is the one sanctioned home for these probes —
+# everywhere else they are a second, unattributed accountant whose
+# numbers never reach the HBM ledger or the flight dump.
+_MEMORY_PROBE_NAMES = {"live_arrays", "memory_stats", "memory_analysis"}
+_MEMORY_SANCTIONED_SUFFIXES = ("horovod_tpu/utils/memory.py",)
+_MEMORY_SCOPE_DIRS = ("horovod_tpu/serving/", "horovod_tpu/ops/")
+_MEMORY_SCOPE_FILES = ("horovod_tpu/trainer.py",)
+
+
+def check_adhoc_memory_probe(ctx, shared):
+    if ctx.relpath.endswith(_MEMORY_SANCTIONED_SUFFIXES):
+        return
+    if "mem_path" not in ctx.roles and not (
+            any(d in ctx.relpath for d in _MEMORY_SCOPE_DIRS) or
+            any(ctx.relpath.endswith(f) for f in _MEMORY_SCOPE_FILES)):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # the terminal attribute, whatever the base expression —
+        # `device.memory_stats()` and `jax.devices()[0].memory_stats()`
+        # are the same probe
+        if isinstance(node.func, ast.Name):
+            probe = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            probe = node.func.attr
+        else:
+            probe = None
+        if probe in _MEMORY_PROBE_NAMES:
+            yield Finding(
+                "HVD020", ctx.relpath, node.lineno, node.col_offset,
+                f"ad-hoc memory probe '{probe}(...)': device-memory "
+                "introspection outside utils/memory.py. Allocator stats "
+                "and live-array scans must ride the memory plane "
+                "(memory.device_memory_stats / step_peak_bytes / "
+                "live_array_bytes, docs/memory.md) so every byte the "
+                "process observes lands in ONE ledger — a stray probe "
+                "reads the allocator on the hot path (a host sync on "
+                "some backends), and its numbers never reach the "
+                "hvd_hbm_bytes gauges, the flight dump, or the OOM "
+                "forecast.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -2079,5 +2128,37 @@ mesh_lib (``named_sharding(spec, mesh)`` accepts an explicit mesh
 for the rare off-global case); keep a local construction only with
 a reason naming why the array lives off the data-plane mesh.""",
             check_adhoc_sharding),
+        Rule(
+            "HVD020", "adhoc-memory-probe",
+            "device-memory introspection outside utils/memory.py in "
+            "the trainer/serving/ops planes",
+            """HVD020 — ad-hoc memory probe outside the memory plane
+
+The memory & compile observability plane (docs/memory.md) sanctions
+exactly one home for device-memory introspection:
+``horovod_tpu/utils/memory.py``, whose ``device_memory_stats`` /
+``step_peak_bytes`` / ``live_array_bytes`` wrappers feed the per-chip
+HBM ledger, the ``hvd_hbm_bytes{component}`` gauges, the flight-dump
+memory section, and the serving OOM forecast.
+
+A direct ``device.memory_stats()``, ``jax.live_arrays()`` or
+``compiled.memory_analysis()`` call anywhere else is a second,
+unattributed accountant. The failure modes: the probe runs on the hot
+path (``live_arrays`` walks the whole live set; ``memory_stats`` is a
+host sync on some backends) without the plane's enabled() gate or its
+<=2% overhead budget (HVD_BENCH_MEM), its numbers never reach the
+ledger so hvd_top and the postmortem tell a different story than the
+call site saw, and CPU CI silently diverges from TPU because the raw
+call has no None-on-missing-stats contract.
+
+Scope: ``horovod_tpu/trainer.py``, ``horovod_tpu/serving/``,
+``horovod_tpu/ops/`` (other files opt in with ``# hvdlint:
+role=mem_path``); ``utils/memory.py`` itself is the sanctioned home.
+
+Fix: call the memory-plane wrapper (it is None-safe and gated), or —
+for byte *attribution* rather than measurement — account the tree
+into the ledger (``get_ledger().account_tree(...)``) and let the
+gauges carry the number.""",
+            check_adhoc_memory_probe),
     ]
 }
