@@ -4,13 +4,19 @@ PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 CPU_MESH = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test native bench examples ci clean
+.PHONY: lint lint-concurrency test native bench examples ci clean
 
 # distributed-correctness static analysis (tools/hvdlint, docs/hvdlint.md);
 # cheapest gate, so it leads the ci chain
 lint:
 	$(PY) -m tools.hvdlint horovod_tpu tools bench.py examples
 	$(PY) -m tools.hvdlint --check-envdoc
+
+# whole-program lock-discipline pass (docs/concurrency.md): guarded_by
+# annotations + LOCK_RANKS order, HVD021/HVD022
+lint-concurrency:
+	$(PY) -m tools.hvdlint --selftest
+	$(PY) -m tools.hvdlint --concurrency
 
 native:
 	$(PY) setup.py build_native
@@ -56,7 +62,7 @@ examples:
 	$(CPU_ENV) $(PY) examples/mxnet_mnist.py --epochs 1 --steps-per-epoch 4
 	$(CPU_MESH) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-ci: lint native test examples
+ci: lint lint-concurrency native test examples
 
 clean:
 	rm -rf build dist *.egg-info /tmp/hvd-ci-imagenet-ckpt \

@@ -16,6 +16,15 @@ echo "--- hvdlint (fastest gate: distributed-correctness static analysis)"
 python -m tools.hvdlint horovod_tpu tools bench.py examples
 python -m tools.hvdlint --check-envdoc
 
+echo "--- hvdlint --concurrency (lock discipline: guarded-by + lock order)"
+# Whole-program pass (docs/concurrency.md): guarded_by annotations
+# enforced interprocedurally (HVD021), acquisitions checked against the
+# LOCK_RANKS order incl. the metrics-reset self-deadlock class (HVD022).
+# The selftest proves both rules still fire on a known-bad fixture —
+# a lint that silently stopped finding anything must fail loudly here.
+python -m tools.hvdlint --selftest
+python -m tools.hvdlint --concurrency
+
 echo "--- build native core"
 python setup.py build_native
 

@@ -1,0 +1,128 @@
+"""The process-wide concurrency contract (docs/concurrency.md).
+
+Every long-lived background thread in this framework — coordinator
+negotiation cycle, checkpoint writer, fleet subscriber loader, metrics
+HTTP server, tracing flight recorder, numerics async drain, elasticity
+control loop, serving replica heartbeat — hangs its shared state off an
+explicit lock, and this module is where those lock contracts become
+*checkable* instead of folklore:
+
+  * **guarded_by annotations** — a shared mutable attribute declares its
+    lock with a trailing comment on the line that first assigns it (or,
+    for multi-line assignments, a standalone comment directly above)::
+
+        self._armed = None          # guarded_by: _lock
+        _registry = None            # guarded_by: _registry_lock
+        # guarded_by: _lock (ring of finished spans)
+        self._spans = collections.deque(
+            maxlen=...)
+
+    ``tools/hvdlint --concurrency`` (HVD021) then reports every read or
+    write of that attribute outside a ``with <lock>:`` scope — including
+    interprocedurally, when a private helper is only ever called with
+    the lock held. Deliberate lock-free fast paths carry an inline
+    ``# hvdlint: disable=HVD021(reason)`` or a reasoned baseline entry.
+
+  * **GUARDED** — the cross-module registry below, for shared state
+    whose declaration line cannot carry a comment (``__slots__``
+    attributes assigned in loops, state declared in one module and
+    guarded in another). Same schema the annotation encodes:
+    ``(file_suffix, class_or_None, attr, lock_name)``.
+
+  * **LOCK_RANKS** — the one global lock ordering. A thread holding a
+    lock may only acquire locks of STRICTLY GREATER rank; acquiring
+    equal-or-lower rank is a static HVD022 finding and, under
+    ``HVD_LOCKDEP=1`` (utils/lockdep.py), a runtime order-violation
+    event. Locks absent from the table are unranked: the cycle detector
+    still witnesses them at runtime, but no static order is enforced.
+
+Lock names: ``ClassName.attr`` for instance locks, ``module.global``
+for module-level locks — exactly the string passed to
+``utils.lockdep.lock(name)`` when a module opts into the runtime
+sanitizer.
+
+This module is PARSED (stdlib ``ast``), never imported, by the lint —
+both tables must stay pure literals. The runtime sanitizer imports it
+normally.
+"""
+
+# ---------------------------------------------------------------------------
+# The global lock ranking. Bands, outermost (acquired first) to
+# innermost (acquired last; may be taken while anything above is held):
+#
+#   10  control plane      — the coordinator's one big lock and the
+#                            eager core's flush lock: held across
+#                            negotiation work that calls into every
+#                            other plane's instruments
+#   20  background cores   — the eager queue (taken inside flush),
+#                            per-plane managers that call into
+#                            telemetry while held
+#   30  plane managers     — checkpoint writer, fleet subscriber,
+#                            serving queue/replica, router, elastic
+#                            controller, run-layer services
+#   40  observability      — tracing rings, timeline writer, numerics
+#                            drain, memory ledger: called from under
+#                            any plane lock
+#   50  module singletons  — lazy get_X() factory locks; callable from
+#                            anywhere, must nest innermost of the
+#                            named planes
+#   60  leaf instruments   — metrics family/instrument locks: a few
+#                            hundred ns hold time, never call out
+#
+# Two locks on the SAME rank must never nest (no order is defined
+# between them); same-lock re-entry of a non-reentrant lock is always a
+# violation.
+# ---------------------------------------------------------------------------
+
+LOCK_RANKS = {
+    # 10 — control plane
+    "CoordinatorService._lock": 10,
+    "EagerCoordinator._flush_lock": 10,
+    # 20 — background cores
+    "EagerCoordinator._queue_lock": 20,
+    "HandleManager._lock": 20,
+    # 30 — plane managers
+    "CheckpointManager._cv": 30,
+    "WeightSubscriber._lock": 30,
+    "AdmissionQueue._lock": 30,
+    "ElasticSupervisor._lock": 30,
+    "ReplicaSupervisorService._op_lock": 30,
+    "LaunchDriverService._lock": 30,
+    "RunFnService._lock": 30,
+    # 40 — observability rings
+    "Tracer._lock": 40,
+    "Timeline._lock": 40,
+    "NumericsMonitor._lock": 40,
+    "NumericsMonitor._pending_lock": 41,
+    "memory._lock": 42,
+    # 50 — module singletons (lazy factories)
+    "metrics._registry_lock": 50,
+    "tracing._tracer_lock": 50,
+    "numerics._monitor_lock": 50,
+    # 60 — leaf instruments
+    "_Family._lock": 60,
+    "Counter._lock": 61,
+    "Gauge._lock": 61,
+    "Histogram._lock": 61,
+}
+
+# ---------------------------------------------------------------------------
+# Cross-module guarded state: attributes whose declaration site cannot
+# carry a trailing ``# guarded_by:`` comment. Schema mirrors the
+# annotation: (file suffix, class name or None for module globals,
+# attribute/global name, lock name as the guarding scope sees it).
+# ---------------------------------------------------------------------------
+
+GUARDED = (
+    # The coordinator's piggyback ledgers are public attributes (the
+    # metrics server and the router read them cross-thread through the
+    # locked snapshot accessors below); their assignment lines in
+    # _handle sit under the lock but the declaration is annotated here
+    # so HVD021 polices every future access site too.
+    ("horovod_tpu/ops/negotiation.py", "CoordinatorService",
+     "metrics_snapshots", "_lock"),
+    ("horovod_tpu/ops/negotiation.py", "CoordinatorService",
+     "load_snapshots", "_lock"),
+    ("horovod_tpu/ops/negotiation.py", "CoordinatorService",
+     "flight_dumps", "_lock"),
+)

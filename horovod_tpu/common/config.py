@@ -557,6 +557,19 @@ ENV_REGISTRY = (
     ("HVD_FLASH_VARIANT", False, None, "ops/flash_attention.py",
      "Flash-attention forward variant override (baseline, "
      "lazy_rescale, two_pass)."),
+    ("HVD_LOCKDEP", False, "0", "utils/lockdep.py",
+     "Set 1 to swap every lockdep.lock() for an instrumented lock that "
+     "witnesses acquisition orders and reports deadlock-shaped bugs "
+     "(order cycles, rank violations, self-deadlock, hold-while-"
+     "blocking) through metrics events, warnings, and flight dumps. "
+     "Unset, lock() returns a raw threading lock — zero overhead."),
+    ("HVD_LOCKDEP_MAX_FINDINGS", False, "32", "utils/lockdep.py",
+     "Cap on stored lockdep findings per process; past it new findings "
+     "are counted but dropped (a hot inversion must not grow memory "
+     "unboundedly)."),
+    ("HVD_LOCKDEP_STALL_S", False, "1.0", "utils/lockdep.py",
+     "Seconds a lock-holding thread may block acquiring another lock "
+     "before lockdep reports hold_while_blocking."),
     ("HVD_TF_NATIVE", False, "1", "tensorflow/native.py",
      "Set 0 to disable the TensorFlow native bridge."),
     ("HVD_TF_NATIVE_ADDR", False, None, "tensorflow/native.py",

@@ -33,6 +33,7 @@ import time
 from ..common import config
 from ..common.exceptions import CheckpointError, CorruptCheckpointError
 from ..utils import checkpoint as hvd_checkpoint
+from ..utils import lockdep
 from ..utils import metrics as hvd_metrics
 
 
@@ -80,12 +81,14 @@ class WeightSubscriber:
                        if verify is None else bool(verify))
         self.device_put = bool(device_put)
         self.clock = clock
-        self._lock = threading.Lock()
-        self._thread = None
-        self._armed = None          # ArmedGeneration standby buffer
-        self._current_gen = None    # last generation handed to the engine
-        self._refused = {}          # generation -> refusal reason
-        self._error = None          # unexpected loader crash, re-raised
+        self._lock = lockdep.lock("WeightSubscriber._lock")
+        self._thread = None       # guarded_by: _lock
+        self._armed = None        # guarded_by: _lock; standby buffer
+        self._current_gen = None  # guarded_by: _lock; last gen taken
+        self._refused = {}        # guarded_by: _lock; gen -> reason
+        self._error = None        # guarded_by: _lock; loader crash
+        # engine-thread-only scratch (no lock: single-writer, never
+        # read by the frontend threads)
         self._last_sig = None
         self._last_poll = None
         reg = self._metrics = hvd_metrics.get_registry()

@@ -58,6 +58,7 @@ from ..parallel import mesh as mesh_lib
 from ..common.exceptions import (DuplicateNameError, MismatchError,
                                  RanksLostError, ShutdownError,
                                  StalledError)
+from ..utils import lockdep
 from ..utils import metrics as hvd_metrics
 from ..utils import numerics as hvd_numerics
 from ..utils import timeline as timeline_mod
@@ -120,9 +121,9 @@ class HandleManager:
     """Integer async handles (torch/handle_manager.h:30-41)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._next = 0
-        self._entries = {}
+        self._lock = lockdep.lock("HandleManager._lock")
+        self._next = 0      # guarded_by: _lock
+        self._entries = {}  # guarded_by: _lock
 
     def allocate(self, entry):
         with self._lock:
@@ -208,10 +209,10 @@ class EagerCoordinator:
         self._mesh = state.mesh
         self._axis = state.mesh.axis_names[0]
         self._world = int(state.mesh.devices.size)
-        self._queue = collections.deque()
-        self._queue_lock = threading.Lock()
-        self._tensor_table = {}  # outstanding names → entry
-        self._flush_lock = threading.Lock()
+        self._queue = collections.deque()  # guarded_by: _queue_lock
+        self._queue_lock = lockdep.lock("EagerCoordinator._queue_lock")
+        self._tensor_table = {}  # guarded_by: _queue_lock; name -> entry
+        self._flush_lock = lockdep.lock("EagerCoordinator._flush_lock")
         self.handles = HandleManager()
         self.plan_cache = PlanCache(self._config.cache_capacity)
         self._shutdown = False
@@ -970,10 +971,14 @@ class EagerCoordinator:
 
     def _remote_metrics_snapshots(self):
         """Rank 0 only: the peers' piggybacked snapshots held by the
-        coordinator service (the MetricsServer's aggregation source)."""
+        coordinator service (the MetricsServer's aggregation source).
+        Runs on the metrics HTTP server thread while the handler thread
+        mutates the ledger, so it must go through the locked accessor —
+        the bare ``dict(svc.metrics_snapshots)`` it replaced could die
+        with "dictionary changed size during iteration" (HVD021)."""
         neg = self._negotiator
         svc = getattr(neg, "service", None) if neg is not None else None
-        return dict(svc.metrics_snapshots) if svc is not None else {}
+        return svc.metrics_snapshot_view() if svc is not None else {}
 
     @staticmethod
     def _meta_of(e, neg):

@@ -38,6 +38,7 @@ import time
 from ..common import hvd_logging as log
 from ..common.exceptions import RanksLostError
 from ..run import network, secret
+from ..utils import lockdep
 from ..utils import metrics as hvd_metrics
 from ..utils import numerics as hvd_numerics
 from ..utils import tracing as hvd_tracing
@@ -484,30 +485,30 @@ class CoordinatorService(network.BasicService):
     def __init__(self, nproc, key, ports, config):
         self._nproc = nproc
         self._config = config  # rank 0's HorovodConfig (live object)
-        self._lock = threading.Lock()
-        self._table = {}          # name -> _TableRow
-        self._order = []          # names in first-submission order
+        self._lock = lockdep.lock("CoordinatorService._lock")
+        self._table = {}     # guarded_by: _lock; name -> _TableRow
+        self._order = []     # guarded_by: _lock; first-submission order
         # responses[i] has seq = _base_seq + i; prefixes every rank has
         # acknowledged are pruned so the log stays bounded over long runs
-        self._responses = []
-        self._base_seq = 0
-        self._acks = {}           # rank -> last acknowledged seq
+        self._responses = []  # guarded_by: _lock
+        self._base_seq = 0    # guarded_by: _lock
+        self._acks = {}       # guarded_by: _lock; rank -> last acked seq
         # rank -> (last processed request id, unknown-id tuple resolved
         # on its FIRST processing). The unknowns are persisted so a
         # deduped retry returns the SAME answer the lost response
         # carried — without this, a dropped response permanently eats
         # the re-announce signal and the hit tensors hang forever
         # (ADVICE.md, medium)
-        self._seen_req = {}
-        self._shutdown = False
+        self._seen_req = {}   # guarded_by: _lock
+        self._shutdown = False  # guarded_by: _lock
         # liveness ledger: rank -> monotonic time of its last cycle.
         # A rank that heartbeated and then went silent past
         # config.rank_lost_timeout_seconds is declared lost (fail-fast
         # RanksLostError at every surviving rank) by _liveness_scan.
         # Ranks never seen are a startup concern owned by the launch
         # timeouts, not by this ledger.
-        self._last_seen = {}
-        self._lost_ranks = set()
+        self._last_seen = {}    # guarded_by: _lock
+        self._lost_ranks = set()  # guarded_by: _lock
         self._ports = ports
         # Response cache (response_cache.h:43-92): names that EXECUTEd get
         # a monotonically increasing cache id; a steady-state resubmission
@@ -515,9 +516,9 @@ class CoordinatorService(network.BasicService):
         # never reused — a stale hit after churn decodes as unknown, not
         # as a silent alias to a different tensor. LRU-bounded by
         # HOROVOD_CACHE_CAPACITY (0 disables caching entirely).
-        self._cache = collections.OrderedDict()  # id -> EntryMeta
-        self._cache_id_of = {}                   # name -> id
-        self._next_cache_id = 0
+        self._cache = collections.OrderedDict()  # guarded_by: _lock
+        self._cache_id_of = {}   # guarded_by: _lock; name -> id
+        self._next_cache_id = 0  # guarded_by: _lock
         # telemetry: piggybacked per-rank snapshots (rank -> snapshot
         # dict) served by rank 0's MetricsServer as the aggregate view,
         # plus the coordinator-side instruments (bound once here — the
@@ -532,19 +533,19 @@ class CoordinatorService(network.BasicService):
         # worker's next cycle piggybacks its flight snapshot — persisted
         # here (rank -> dump path) by utils/tracing.write_remote_dump
         self._tracer = hvd_tracing.get_tracer()
-        self._dump_requested = False
+        self._dump_requested = False  # guarded_by: _lock
         self.flight_dumps = {}
         # divergence sentinel (utils/numerics.py): per-cycle digests by
         # rank, compared as they arrive; a disagreement past tolerance
         # escalates once per (cycle, tensor, kind) through the standard
         # path (event -> warning -> dump solicitation -> postmortem)
-        self._digests = {}            # cycle -> rank -> {name: record}
+        self._digests = {}  # guarded_by: _lock; cycle -> rank -> records
         # (cycle, tensor, kind) -> blamed rank. A dict, not a set: the
         # first record to expose an anomaly may lack blame evidence
         # (e.g. reduced-side nonfinites before the poisoned rank's local
         # digest arrives), and the flag upgrades once a culprit is known
-        self._numerics_flagged = {}
-        self._numerics_first_bad = {}   # tensor -> first bad cycle
+        self._numerics_flagged = {}    # guarded_by: _lock
+        self._numerics_first_bad = {}  # guarded_by: _lock
         # wire-codec agreement: rank 0's codec-config fingerprint is the
         # negotiated truth; any rank whose piggybacked fingerprint
         # differs is recorded here and every subsequently ready tensor
@@ -552,7 +553,7 @@ class CoordinatorService(network.BasicService):
         # silently corrupted quantized sum (ops/quantization.py)
         from . import quantization
         self._codec_fp = quantization.config_fingerprint(config)
-        self._codec_mismatch = {}       # rank -> offending fingerprint
+        self._codec_mismatch = {}  # guarded_by: _lock; rank -> their fp
         reg = self._metrics = hvd_metrics.get_registry()
         self._m_cycles = reg.counter(
             "hvd_coordinator_cycles_total",
@@ -715,6 +716,28 @@ class CoordinatorService(network.BasicService):
                     unknown_ids=unknown,
                     lost_ranks=sorted(self._lost_ranks))
         raise NotImplementedError(req)
+
+    # Locked snapshot accessors. The public ledgers above are mutated
+    # under self._lock by the TCP handler thread; every OTHER thread
+    # (rank 0's metrics HTTP server, the router's scorer, chaos drills)
+    # must read through these point-in-time copies — iterating the live
+    # dict races the handler and can raise "dictionary changed size
+    # during iteration". HVD021 (common/concurrency.py GUARDED) polices
+    # every access site.
+    def metrics_snapshot_view(self):
+        """Copy of the piggybacked per-rank metrics ledger."""
+        with self._lock:
+            return dict(self.metrics_snapshots)
+
+    def load_snapshot_view(self):
+        """Copy of the per-replica serving-load ledger."""
+        with self._lock:
+            return dict(self.load_snapshots)
+
+    def flight_dump_view(self):
+        """Copy of the rank -> flight-dump-path ledger."""
+        with self._lock:
+            return dict(self.flight_dumps)
 
     # retained-response cap: a rank that crashed (or never reaches the
     # eager API) must not let the log grow unboundedly for the rest of a

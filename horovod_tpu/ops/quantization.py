@@ -180,7 +180,7 @@ class ErrorFeedback:
     (elastic resize, recompiled model)."""
 
     def __init__(self):
-        self._residuals = {}
+        self._residuals = {}  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def compensate(self, key, x):

@@ -19,7 +19,7 @@ class Cache:
                                   f"cache_{parameters_hash}.pkl")
         self._ttl = ttl_s
         self._lock = threading.Lock()
-        self._store = {}
+        self._store = {}  # guarded_by: _lock
         try:
             with open(self._path, "rb") as f:
                 self._store = pickle.load(f)

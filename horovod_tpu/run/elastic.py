@@ -24,6 +24,7 @@ import threading
 import time
 
 from ..common import hvd_logging as log
+from ..utils import lockdep
 from . import exec_util
 from .hosts import HostSlots, parse_hosts
 from .network import BasicClient, BasicService
@@ -92,9 +93,9 @@ class ElasticSupervisor:
         self.shrink_slots = shrink_slots
         self.max_restarts = max_restarts
         self.restarts = 0
-        self._exit_code = 0
-        self._proc = None
-        self._lock = threading.Lock()
+        self._exit_code = 0  # GIL-atomic int; listener writes, wait() reads
+        self._proc = None    # guarded_by: _lock
+        self._lock = lockdep.lock("ElasticSupervisor._lock")
         self._stop = threading.Event()
         self._listener = None
         self._sock = None
@@ -267,8 +268,10 @@ class ElasticSupervisor:
                     except ValueError as e:
                         print(f"elastic: ERROR: cannot shrink further: "
                               f"{e}")
-                self.shutdown()
-                return rc
+            # falling out of the locked block (no restart path taken)
+            # means the job is done; shutdown re-takes the lock itself
+            self.shutdown()
+            return rc
         return self._exit_code
 
     def shutdown(self):
@@ -278,7 +281,12 @@ class ElasticSupervisor:
                 self._sock.close()
             except OSError:
                 pass
-        self._kill_job()
+        # under the lock: the listener thread may be mid-restart
+        # (_remove_slots_locked kills and respawns _proc while locked),
+        # and killing the half-replaced process off-lock would leak the
+        # freshly spawned one
+        with self._lock:
+            self._kill_job()
 
 
 # ---------------------------------------------------------------------------
@@ -337,8 +345,8 @@ class ReplicaSupervisorService(BasicService):
         super().__init__(self.NAME, key)
         self._on_spawn = on_spawn
         self._on_drain = on_drain
-        self._op_lock = threading.Lock()
-        self._ledger = collections.OrderedDict()  # change_id -> response
+        self._op_lock = lockdep.lock("ReplicaSupervisorService._op_lock")
+        self._ledger = collections.OrderedDict()  # guarded_by: _op_lock
 
     def _handle(self, req, client_address):
         if isinstance(req, (SpawnReplicaRequest, DrainReplicaRequest)):

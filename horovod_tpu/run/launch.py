@@ -13,6 +13,7 @@ import threading
 import sys
 import traceback
 
+from ..utils import lockdep
 from . import hosts as hosts_mod
 from . import secret
 from .cli import _free_port, run_command_on_hosts
@@ -47,8 +48,8 @@ class RunFnService(BasicService):
         super().__init__(self.NAME, key)
         self._fn, self._args, self._kwargs = fn, args, kwargs
         self._num_proc = num_proc
-        self._results = {}
-        self._lock = threading.Lock()
+        self._results = {}  # guarded_by: _lock
+        self._lock = lockdep.lock("RunFnService._lock")
         self._all_done = threading.Event()
 
     def _handle(self, req, client_address):

@@ -51,8 +51,8 @@ class Wire:
         # body), observable by control-plane diagnostics and benches.
         # Lock-guarded: one Wire is shared by all of a service's handler
         # threads (BasicService._make_handler).
-        self.bytes_out = 0
-        self.bytes_in = 0
+        self.bytes_out = 0  # guarded_by: _count_lock
+        self.bytes_in = 0   # guarded_by: _count_lock
         self._count_lock = threading.Lock()
 
     def write(self, obj, wfile):
@@ -158,7 +158,7 @@ class BasicService:
         # live persistent connections: shutdown() must sever them, or
         # clients looping on an established socket would keep being
         # served by daemon handler threads after the accept loop stops
-        self._conns = set()
+        self._conns = set()  # guarded_by: _conns_lock
         self._conns_lock = threading.Lock()
         self._closing = False
         self._server = self._bind_ephemeral()

@@ -18,6 +18,7 @@ command execution). The flow (reference run/run.py:188-257):
 
 import threading
 
+from ..utils import lockdep
 from . import exec_util
 from .network import AckResponse, BasicClient, BasicService
 from .settings import Timeout
@@ -82,10 +83,10 @@ class LaunchDriverService(BasicService):
         self._num_tasks = num_tasks
         self._all_registered = threading.Event()
         self._all_routable = threading.Event()
-        self._lock = threading.Lock()
-        self._task_addresses = {}
-        self._task_host_hash = {}
-        self._routable = {}
+        self._lock = lockdep.lock("LaunchDriverService._lock")
+        self._task_addresses = {}  # guarded_by: _lock
+        self._task_host_hash = {}  # guarded_by: _lock
+        self._routable = {}        # guarded_by: _lock
 
     def _handle(self, req, client_address):
         if isinstance(req, RegisterTaskRequest):
@@ -127,14 +128,15 @@ class LaunchDriverService(BasicService):
         """Intersect interface names over every ring probe result
         (reference run/run.py:245-255)."""
         with self._lock:
-            sets = [set(v.keys()) for v in self._routable.values()]
+            routable = dict(self._routable)
+        sets = [set(v.keys()) for v in routable.values()]
         if not sets:
             return set()
         common = set.intersection(*sets)
         if not common:
             raise RuntimeError(
                 "Unable to find a set of network interfaces common to all "
-                f"hosts; per-task routable interfaces: {self._routable}")
+                f"hosts; per-task routable interfaces: {routable}")
         return common
 
 

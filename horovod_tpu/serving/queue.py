@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..common import config
+from ..utils import lockdep
 from ..utils import metrics as hvd_metrics
 from . import tracing as serve_tracing
 
@@ -81,8 +82,8 @@ class AdmissionQueue:
             config.env_float("SERVE_ADMISSION_TIMEOUT_S", 10.0)
             if admission_timeout_s is None else admission_timeout_s)
         self._clock = clock
-        self._lock = threading.Lock()
-        self._q = deque()
+        self._lock = lockdep.lock("AdmissionQueue._lock")
+        self._q = deque()  # guarded_by: _lock
         reg = hvd_metrics.get_registry()
         self._m_requests = reg.counter(
             "hvd_serve_requests_total",

@@ -96,8 +96,7 @@ class ReplicaGroup:
         service = self._worker.service
         if service is None:
             return {}
-        with service._lock:
-            return dict(service.load_snapshots)
+        return service.load_snapshot_view()
 
     def close(self, linger_s=0.5):
         self._worker.close(linger_s=linger_s)

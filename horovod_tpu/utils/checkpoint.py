@@ -597,11 +597,11 @@ class CheckpointManager:
         self.commit_timeout_s = commit_timeout_s
         os.makedirs(directory, exist_ok=True)
         self._cv = threading.Condition()
-        self._pending = None     # latest queued snapshot (latest-wins)
-        self._busy = False
-        self._error = None
-        self._thread = None
-        self._closed = False
+        self._pending = None  # guarded_by: _cv; latest queued snapshot
+        self._busy = False    # guarded_by: _cv
+        self._error = None    # guarded_by: _cv
+        self._thread = None   # guarded_by: _cv
+        self._closed = False  # guarded_by: _cv
 
     # -- instruments (created lazily so HVD_METRICS=0 stays free) ------
 
@@ -652,8 +652,9 @@ class CheckpointManager:
         queued ones.
         """
         self._raise_if_failed()
-        if self._closed:
-            raise CheckpointError("CheckpointManager is closed")
+        with self._cv:
+            if self._closed:
+                raise CheckpointError("CheckpointManager is closed")
         t0 = time.perf_counter()
         names, leaves = _flatten_with_names(tree)
         # host-pinned copies NOW, at the step boundary: the step loop is
@@ -721,9 +722,11 @@ class CheckpointManager:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=self.commit_timeout_s)
-            self._thread = None
+            # capture-and-clear under the condition: the join itself
+            # must happen off-lock (the writer needs _cv to exit)
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=self.commit_timeout_s)
         self._raise_if_failed()
 
     # -- writer --------------------------------------------------------

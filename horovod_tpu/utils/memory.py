@@ -36,6 +36,7 @@ import re
 import threading
 
 from ..common.config import env_bool, env_float, env_int
+from . import lockdep
 
 log = logging.getLogger("horovod_tpu.memory")
 
@@ -43,18 +44,19 @@ log = logging.getLogger("horovod_tpu.memory")
 COMPONENTS = ("params", "opt_state", "grads", "kv_cache", "activations",
               "other")
 
-_lock = threading.RLock()
-_enabled = None
-_ledger = None
-_tracker = None
+_lock = lockdep.rlock("memory._lock")
+_enabled = None  # guarded_by: _lock; cached HVD_MEM switch
+_ledger = None   # guarded_by: _lock
+_tracker = None  # guarded_by: _lock
 
 
 def enabled():
     """Master switch (HVD_MEM, default on). Cached; reset() re-reads."""
     global _enabled
-    if _enabled is None:
-        _enabled = env_bool("MEM", True)
-    return _enabled
+    with _lock:
+        if _enabled is None:
+            _enabled = env_bool("MEM", True)
+        return _enabled
 
 
 def reset(enabled=None):
@@ -680,15 +682,19 @@ def flight_section():
         if not enabled():
             return None
         with _lock:
-            have = (_ledger is not None and _ledger._components) or \
-                (_tracker is not None and _tracker._sites)
+            # capture the singletons under the lock: a concurrent
+            # reset() must not null them between the emptiness check
+            # and the snapshot calls below
+            ledger, tracker = _ledger, _tracker
+            have = (ledger is not None and ledger._components) or \
+                (tracker is not None and tracker._sites)
         if not have:
             return None
         section = {}
-        if _ledger is not None:
-            section["hbm"] = _ledger.snapshot()
-        if _tracker is not None:
-            section["compile"] = _tracker.site_summary()
+        if ledger is not None:
+            section["hbm"] = ledger.snapshot()
+        if tracker is not None:
+            section["compile"] = tracker.site_summary()
         return section or None
     # hvdlint: disable=HVD006(flight dumps must land even when the memory plane is mid-teardown; the section is simply absent)
     except Exception:  # noqa: BLE001

@@ -98,7 +98,7 @@ class Counter:
     __slots__ = ("_value", "_lock")
 
     def __init__(self):
-        self._value = 0.0
+        self._value = 0.0  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def inc(self, amount=1):
@@ -107,6 +107,7 @@ class Counter:
 
     @property
     def value(self):
+        # hvdlint: disable=HVD021(GIL-atomic float read for exposition; writers serialize under _lock)
         return self._value
 
 
@@ -118,7 +119,7 @@ class Gauge:
     __slots__ = ("_value", "_lock")
 
     def __init__(self):
-        self._value = 0.0
+        self._value = 0.0  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def set(self, value):
@@ -131,6 +132,7 @@ class Gauge:
 
     @property
     def value(self):
+        # hvdlint: disable=HVD021(GIL-atomic float read for exposition; writers serialize under _lock)
         return self._value
 
 
@@ -148,9 +150,9 @@ class Histogram:
         self.bounds = tuple(float(b) for b in bounds)
         if list(self.bounds) != sorted(self.bounds):
             raise ValueError(f"histogram bounds not sorted: {bounds}")
-        self._counts = [0] * (len(self.bounds) + 1)
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * (len(self.bounds) + 1)  # guarded_by: _lock
+        self._sum = 0.0    # guarded_by: _lock
+        self._count = 0    # guarded_by: _lock
         self._lock = threading.Lock()
 
     def observe(self, value):
@@ -167,14 +169,17 @@ class Histogram:
 
     @property
     def counts(self):
+        # hvdlint: disable=HVD021(the list copy is element-atomic under the GIL; a snapshot mid-observe lags by one sample)
         return list(self._counts)
 
     @property
     def sum(self):
+        # hvdlint: disable=HVD021(GIL-atomic float read for exposition; writers serialize under _lock)
         return self._sum
 
     @property
     def count(self):
+        # hvdlint: disable=HVD021(GIL-atomic int read for exposition; writers serialize under _lock)
         return self._count
 
 
@@ -194,11 +199,12 @@ class _Family:
         self.kind = kind
         self.label_names = tuple(label_names)
         self.bounds = bounds
-        self._children = {}
+        self._children = {}  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def labels(self, **label_values):
         key = tuple(str(label_values.get(n, "")) for n in self.label_names)
+        # hvdlint: disable=HVD021(double-checked child lookup; the miss path re-probes under _lock before inserting)
         child = self._children.get(key)
         if child is None:
             with self._lock:
@@ -238,10 +244,10 @@ class MetricsRegistry:
     def __init__(self, rank=None, clock=None):
         self.rank = rank
         self.clock = clock or _CLOCK
-        self._families = collections.OrderedDict()
+        self._families = collections.OrderedDict()  # guarded_by: _lock
         self._lock = threading.Lock()
-        self._events = collections.deque(maxlen=self.EVENT_RING)
-        self._events_dropped = 0
+        self._events = collections.deque(maxlen=self.EVENT_RING)  # guarded_by: _lock
+        self._events_dropped = 0  # guarded_by: _lock
         # optional JSONL sink for the event log
         self._event_file = None
         path = _env("METRICS_EVENT_LOG")
@@ -256,6 +262,7 @@ class MetricsRegistry:
         return True
 
     def _family(self, name, help_text, kind, labels, bounds=None):
+        # hvdlint: disable=HVD021(double-checked family lookup; the miss path re-probes under _lock before inserting)
         fam = self._families.get(name)
         if fam is None:
             with self._lock:
@@ -337,7 +344,9 @@ class MetricsRegistry:
                     entry["values"].append(
                         {"labels": lv, "value": child.value})
             metrics[fam.name] = entry
-        events = self.events()
+        with self._lock:
+            events = list(self._events)
+            dropped = self._events_dropped
         if max_events is not None:
             events = events[-max_events:]
         return {
@@ -347,7 +356,7 @@ class MetricsRegistry:
             "epoch_us_at_ts0": self.clock.epoch_us_at_ts0,
             "metrics": metrics,
             "events": events,
-            "events_dropped": self._events_dropped,
+            "events_dropped": dropped,
         }
 
     def to_prometheus(self, extra_labels=None):
@@ -420,7 +429,7 @@ def _env(name, default=None):
     return default
 
 
-_registry = None
+_registry = None  # guarded_by: _registry_lock
 _registry_lock = threading.Lock()
 
 
@@ -428,6 +437,7 @@ def get_registry():
     """The process-wide registry (created on first use; honors
     HVD_METRICS=0 with a no-op registry)."""
     global _registry
+    # hvdlint: disable=HVD021(double-checked init fast path; the slow path re-reads under _registry_lock before publishing)
     reg = _registry
     if reg is None:
         with _registry_lock:

@@ -47,6 +47,7 @@ import threading
 
 from ..common import hvd_logging as log
 from ..common.config import env_bool, env_float, env_int
+from . import lockdep
 from . import metrics as metrics_mod
 from . import tracing as tracing_mod
 
@@ -415,15 +416,15 @@ class NumericsMonitor:
                        else env_float("NUMERICS_EMA_K", 8.0))
         self._warmup = (warmup if warmup is not None
                         else env_int("NUMERICS_WARMUP", 5))
-        self._lock = threading.Lock()
-        self._ema = {}        # tensor -> EMA of local L2 norm
-        self._obs = {}        # tensor -> observation count
-        self._children = {}   # tensor -> bound per-tensor gauge children
+        self._lock = lockdep.lock("NumericsMonitor._lock")
+        self._ema = {}        # guarded_by: _lock; tensor -> EMA of L2 norm
+        self._obs = {}        # guarded_by: _lock; tensor -> observation count
+        self._children = {}   # guarded_by: _lock; tensor -> gauge children
         # parked async results: (names, k, unforced device [pow2, 5])
-        self._pending_lock = threading.Lock()
-        self._parked = collections.deque()
-        self._flagged = set()  # (tensor, kind): one event per pair
-        self._dumped = False   # one flight dump per process
+        self._pending_lock = lockdep.lock("NumericsMonitor._pending_lock")
+        self._parked = collections.deque()  # guarded_by: _pending_lock
+        self._flagged = set()  # guarded_by: _lock; one event per (tensor, kind)
+        self._dumped = False   # guarded_by: _lock; one flight dump per process
         reg = metrics_mod.get_registry()
         self._m_norm = reg.gauge(
             "hvd_grad_norm",
@@ -659,14 +660,15 @@ class NullMonitor:
         return None
 
 
-_monitor = None
-_monitor_lock = threading.Lock()
+_monitor = None  # guarded_by: _monitor_lock
+_monitor_lock = lockdep.lock("numerics._monitor_lock")
 
 
 def get_monitor():
     """The process-wide monitor (created on first use; HVD_NUMERICS=0
     yields a no-op monitor)."""
     global _monitor
+    # hvdlint: disable=HVD021(double-checked init fast path; the slow path re-reads under _monitor_lock before publishing)
     m = _monitor
     if m is None:
         with _monitor_lock:

@@ -25,6 +25,8 @@ import queue
 import threading
 import time
 
+from . import lockdep
+
 # Activity names (reference common.h:30-51).
 QUEUE = "QUEUE"
 MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
@@ -44,10 +46,10 @@ class Timeline:
     def __init__(self, filename, mark_cycles=False):
         self._filename = filename
         self._mark_cycles = mark_cycles
-        self._queue = queue.SimpleQueue()
-        self._tensor_pids = {}
-        self._next_pid = 1
-        self._lock = threading.Lock()
+        self._queue = queue.SimpleQueue()  # thread-safe; no lock needed
+        self._tensor_pids = {}  # guarded_by: _lock
+        self._next_pid = 1      # guarded_by: _lock
+        self._lock = lockdep.lock("Timeline._lock")
         self._healthy = True
         # The process-wide shared clock (utils/metrics.py): trace ts and
         # metric/event ts_us ride the same monotonic base, and the

@@ -43,6 +43,7 @@ import threading
 
 from ..common import hvd_logging as log
 from ..common.config import env_bool, env_float, env_int, env_str
+from . import lockdep
 from . import metrics as metrics_mod
 
 FLIGHT_VERSION = 1
@@ -208,16 +209,18 @@ class Tracer:
                  cycle_ring=None, slow_ms=None, dump_dir=None):
         self.rank = rank
         self.clock = clock or metrics_mod.shared_clock()
-        self._lock = threading.Lock()
-        self._seq = 0
-        self._span_seq = 0
+        self._lock = lockdep.lock("Tracer._lock")
+        self._seq = 0       # guarded_by: _lock
+        self._span_seq = 0  # guarded_by: _lock
+        # guarded_by: _lock (finished-span flight ring)
         self._spans = collections.deque(
             maxlen=span_ring or env_int("FLIGHT_SPANS", 2048))
+        # guarded_by: _lock (coordinator cycle ring)
         self._cycles = collections.deque(
             maxlen=cycle_ring or env_int("FLIGHT_CYCLES", 64))
-        self._open = collections.OrderedDict()   # span_id -> Span
-        self._last_trace = {}                    # tensor -> trace_id
-        self._spans_dropped = 0
+        self._open = collections.OrderedDict()  # guarded_by: _lock
+        self._last_trace = {}     # guarded_by: _lock; tensor -> trace_id
+        self._spans_dropped = 0   # guarded_by: _lock
         self._slow_us = (slow_ms if slow_ms is not None
                          else env_float("TRACE_SLOW_MS", 100.0)) * 1000.0
         self._dump_dir = dump_dir or env_str(
@@ -244,6 +247,7 @@ class Tracer:
 
     def trace_id_for(self, tensor):
         """Latest trace id minted for ``tensor`` (None if never traced)."""
+        # hvdlint: disable=HVD021(GIL-atomic get on an append-only map; a stale read is just the previous trace id)
         return self._last_trace.get(tensor)
 
     # -- spans --
@@ -253,9 +257,13 @@ class Tracer:
         ``abort()`` (use the context-manager form when the extent is
         lexical); hvdlint HVD008 enforces this at call sites."""
         if trace_id is None:
-            if tensor is not None and tensor in self._last_trace:
-                trace_id = self._last_trace[tensor]
-            else:
+            if tensor is not None:
+                # one atomic get instead of the old check-then-read
+                # pair (HVD021 flagged the TOCTOU shape; entries are
+                # append-only so a stale id is benign, a KeyError not)
+                # hvdlint: disable=HVD021(GIL-atomic get on an append-only map; a stale read is just the previous trace id)
+                trace_id = self._last_trace.get(tensor)
+            if trace_id is None:
                 trace_id = self.new_trace_id(tensor)
         parent_id = parent.span_id if isinstance(parent, Span) else parent
         with self._lock:
@@ -409,8 +417,8 @@ class NullTracer:
         return None
 
 
-_tracer = None
-_tracer_lock = threading.Lock()
+_tracer = None  # guarded_by: _tracer_lock
+_tracer_lock = lockdep.lock("tracing._tracer_lock")
 
 
 def get_tracer():
@@ -418,6 +426,7 @@ def get_tracer():
     yields a no-op tracer).  Rank is adopted lazily via ``set_rank`` once
     hvd.init() knows it — spans minted before then carry rank None."""
     global _tracer
+    # hvdlint: disable=HVD021(double-checked init fast path; the slow path re-reads under _tracer_lock before publishing)
     t = _tracer
     if t is None:
         with _tracer_lock:

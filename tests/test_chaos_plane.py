@@ -774,7 +774,13 @@ class TestDrillServingReplicaLost:
         heartbeat, a serve_failover event, a flight dump — and KEEP
         SERVING: requests submitted after the failover still complete.
         Then THIS process runs hvd_postmortem over the dumps and the
-        verdict must name the lost replica."""
+        verdict must name the lost replica.
+
+        The whole drill runs under HVD_LOCKDEP=1: every control-plane
+        lock (coordinator, admission queue, tracer, metrics) is the
+        instrumented kind, and the healthy path must produce ZERO
+        lockdep findings — no inversions, no stalls — even while a
+        peer wedges and the engine fails over."""
 
         def fn():
             import os
@@ -785,6 +791,7 @@ class TestDrillServingReplicaLost:
             from horovod_tpu.serving.engine import ServeEngine
             from horovod_tpu.serving.queue import AdmissionQueue, Request
             from horovod_tpu.serving.replica import ReplicaGroup
+            from horovod_tpu.utils import lockdep
             from horovod_tpu.utils import tracing as hvd_tracing
 
             r = int(os.environ["HVD_PROCESS_ID"])
@@ -805,7 +812,7 @@ class TestDrillServingReplicaLost:
                         time.monotonic() < deadline:
                     time.sleep(0.1)
                 group.close(linger_s=0.0)
-                return (r, None, None, None)
+                return (r, None, None, None, lockdep.findings())
 
             # replica 0: a real serving engine riding the group. Warm
             # the jit caches BEFORE joining — multi-second compiles
@@ -855,16 +862,22 @@ class TestDrillServingReplicaLost:
             results.extend(engine.run_to_completion())
             completed = sorted(x.request_id for x in results
                                if x.outcome == "completed")
-            return (r, detect_s, lost_box, completed)
+            return (r, detect_s, lost_box, completed, lockdep.findings())
 
         env = dict(_ENV)
         env["HVD_FLIGHT_DIR"] = str(tmp_path)
+        env["HVD_LOCKDEP"] = "1"
         env["DRILL_PORT"] = str(network.free_port())
         env["DRILL_DONE_FILE"] = str(tmp_path / "victim.done")
         results = run(fn, num_proc=2, env=env, start_timeout_s=180.0)
 
         by_rank = {x[0]: x for x in results}
-        _, detect_s, lost_box, completed = by_rank[0]
+        _, detect_s, lost_box, completed, _ = by_rank[0]
+        # the lock-order sanitizer rode the whole drill on both
+        # replicas: the healthy path must be finding-free
+        for rank, result in sorted(by_rank.items()):
+            assert result[4] == [], (
+                f"lockdep findings on replica {rank}: {result[4]}")
         assert detect_s is not None, \
             "replica 0 never detected the wedged peer (the silent hang)"
         assert detect_s < 30.0, f"detection took {detect_s:.1f}s"

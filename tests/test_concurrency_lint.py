@@ -1,0 +1,571 @@
+"""The concurrency pass tested: the three historical race classes as
+named regression fixtures (each pre-fix shape must be flagged; each
+fixed shape must lint clean), the locked-accessor fixes' unit tests,
+and the runtime lockdep sanitizer's detection + escalation contract
+(docs/concurrency.md).
+
+Fixture snippets are written to tmp_path and scanned with
+``analyze_paths(..., program_pass=run_pass)`` — the exact invocation
+``python -m tools.hvdlint --concurrency`` makes. Lock ranks for
+fixtures come from per-file ``# lock_rank:`` comments, the same escape
+hatch a module outside common/concurrency.py's table would use.
+"""
+
+import glob
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from tools.hvdlint import analyze_paths
+from tools.hvdlint.concurrency import run_pass, selftest
+
+
+def lint_concurrency(tmp_path, source, name="snippet.py"):
+    """Write one fixture file and run only the concurrency pass on it."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    findings, _ = analyze_paths([str(f)], rules={}, program_pass=run_pass)
+    return findings
+
+
+def live(findings, rule=None):
+    return [f for f in findings if not f.suppressed and
+            (rule is None or f.rule == rule)]
+
+
+# ---------------------------------------------------------------------------
+# historical race fixture 1: the metrics-registry reset() self-deadlock.
+# Pre-fix shape: reset() held the module singleton lock and called the
+# factory, which re-acquires the same non-reentrant lock — a guaranteed
+# hang the chaos drill caught dynamically. HVD022 flags it statically.
+# ---------------------------------------------------------------------------
+
+METRICS_RESET_PRE_FIX = """\
+    import threading
+
+    _registry = None  # guarded_by: _registry_lock
+    _registry_lock = threading.Lock()
+
+    def get_registry():
+        global _registry
+        with _registry_lock:
+            if _registry is None:
+                _registry = object()
+            return _registry
+
+    def reset():
+        global _registry
+        with _registry_lock:
+            _registry = None
+            return get_registry()
+    """
+
+
+def test_fixture_metrics_reset_self_deadlock_flagged(tmp_path):
+    found = live(lint_concurrency(tmp_path, METRICS_RESET_PRE_FIX),
+                 "HVD022")
+    assert found, "pre-fix reset() shape must raise HVD022"
+    assert any("self-deadlock" in f.message for f in found)
+    assert any("get_registry" in f.message for f in found)
+
+
+def test_fixture_metrics_reset_fixed_shape_clean(tmp_path):
+    # the fix: drop the lock before re-entering the factory (exactly
+    # what horovod_tpu/utils/metrics.py reset() does today)
+    found = lint_concurrency(tmp_path, """\
+        import threading
+
+        _registry = None  # guarded_by: _registry_lock
+        _registry_lock = threading.Lock()
+
+        def get_registry():
+            global _registry
+            with _registry_lock:
+                if _registry is None:
+                    _registry = object()
+                return _registry
+
+        def reset():
+            global _registry
+            with _registry_lock:
+                _registry = None
+            return get_registry()
+        """)
+    assert live(found) == []
+
+
+# ---------------------------------------------------------------------------
+# historical race fixture 2: the shm_ring lost-wake. Pre-fix shape: the
+# producer raised the ready flag OUTSIDE the lock that orders it with
+# the consumer's check — the consumer could read stale False and sleep
+# through the wake. HVD021 flags both off-lock touches, and names the
+# consumer's thread entry.
+# ---------------------------------------------------------------------------
+
+SHM_RING_PRE_FIX = """\
+    import threading
+
+    class ShmRing:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ready = False  # guarded_by: _lock
+            self._slots = []     # guarded_by: _lock
+            self._thread = threading.Thread(target=self._consume,
+                                            daemon=True)
+            self._thread.start()
+
+        def push(self, item):
+            with self._lock:
+                self._slots.append(item)
+            self._ready = True
+
+        def _consume(self):
+            while True:
+                if self._ready:
+                    with self._lock:
+                        self._slots.clear()
+    """
+
+
+def test_fixture_shm_ring_lost_wake_flagged(tmp_path):
+    found = live(lint_concurrency(tmp_path, SHM_RING_PRE_FIX), "HVD021")
+    msgs = [f.message for f in found]
+    assert any("written off-lock" in m and "_ready" in m for m in msgs), \
+        "producer's off-lock flag write must be flagged"
+    assert any("read off-lock" in m and "_ready" in m for m in msgs), \
+        "consumer's off-lock flag check must be flagged"
+    # the consumer finding must name its thread entry — that is what
+    # makes the report actionable
+    assert any("thread entry 'ShmRing._consume'" in m for m in msgs)
+
+
+def test_fixture_shm_ring_fixed_shape_clean(tmp_path):
+    found = lint_concurrency(tmp_path, """\
+        import threading
+
+        class ShmRing:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ready = False  # guarded_by: _lock
+                self._slots = []     # guarded_by: _lock
+                self._thread = threading.Thread(target=self._consume,
+                                                daemon=True)
+                self._thread.start()
+
+            def push(self, item):
+                with self._lock:
+                    self._slots.append(item)
+                    self._ready = True
+
+            def _consume(self):
+                while True:
+                    with self._lock:
+                        if self._ready:
+                            self._slots.clear()
+        """)
+    assert live(found) == []
+
+
+# ---------------------------------------------------------------------------
+# historical race fixture 3: the fleet poll/GC TOCTOU. Pre-fix shape:
+# the subscriber's poller read the publication pointer off-lock while
+# the retention-GC thread unlinked it — the poller then opened a
+# directory that no longer existed. HVD021 flags the off-lock read.
+# ---------------------------------------------------------------------------
+
+FLEET_POLL_PRE_FIX = """\
+    import threading
+
+    class Publisher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None  # guarded_by: _lock
+            self._gc = threading.Thread(target=self._gc_loop, daemon=True)
+            self._gc.start()
+
+        def publish(self, path):
+            with self._lock:
+                self._latest = path
+
+        def poll(self):
+            return self._latest
+
+        def _gc_loop(self):
+            with self._lock:
+                self._latest = None
+    """
+
+
+def test_fixture_fleet_poll_gc_toctou_flagged(tmp_path):
+    found = live(lint_concurrency(tmp_path, FLEET_POLL_PRE_FIX), "HVD021")
+    assert any("_latest" in f.message and "read off-lock" in f.message
+               for f in found), \
+        "the poller's off-lock pointer read must be flagged"
+
+
+def test_fixture_fleet_poll_fixed_shape_clean(tmp_path):
+    found = lint_concurrency(tmp_path, """\
+        import threading
+
+        class Publisher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._latest = None  # guarded_by: _lock
+                self._gc = threading.Thread(target=self._gc_loop,
+                                            daemon=True)
+                self._gc.start()
+
+            def publish(self, path):
+                with self._lock:
+                    self._latest = path
+
+            def poll(self):
+                with self._lock:
+                    return self._latest
+
+            def _gc_loop(self):
+                with self._lock:
+                    self._latest = None
+        """)
+    assert live(found) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD022 rank inversion + pass-level suppression mechanics
+# ---------------------------------------------------------------------------
+
+def test_hvd022_rank_inversion_from_lock_rank_comments(tmp_path):
+    found = live(lint_concurrency(tmp_path, """\
+        import threading
+
+        # lock_rank: Box._outer = 10
+        # lock_rank: Box._inner = 20
+
+        class Box:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+
+            def bad(self):
+                with self._inner:
+                    with self._outer:
+                        pass
+
+            def good(self):
+                with self._outer:
+                    with self._inner:
+                        pass
+        """), "HVD022")
+    assert len(found) == 1
+    assert "inversion" in found[0].message
+    assert "'_outer' (rank 10)" in found[0].message
+
+
+def test_lock_held_by_private_helper_caller_is_credited(tmp_path):
+    # the RacerD-style fixpoint: a private helper whose every call site
+    # holds the lock is analyzed as entered-locked — no false positive
+    found = lint_concurrency(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0  # guarded_by: _lock
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._value += 1
+        """)
+    assert live(found) == []
+
+
+def test_concurrency_findings_honor_inline_disable(tmp_path):
+    found = lint_concurrency(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0  # guarded_by: _lock
+
+            def peek(self):
+                # hvdlint: disable=HVD021(GIL-atomic int read for a monitoring endpoint)
+                return self._value
+        """)
+    assert live(found) == []
+    assert any(f.suppressed and f.rule == "HVD021" for f in found)
+
+
+def test_selftest_passes():
+    assert selftest() is None
+
+
+# ---------------------------------------------------------------------------
+# the accessor fixes from the annotation sweep (satellite: true
+# positives found by the pass, each with a unit test)
+# ---------------------------------------------------------------------------
+
+def test_coordinator_snapshot_accessors_return_copies():
+    """eager._remote_metrics_snapshots read svc.metrics_snapshots from
+    the metrics HTTP thread without the coordinator's lock; the fix
+    routes every cross-thread reader through locked accessors that
+    return copies."""
+    from horovod_tpu.ops.negotiation import CoordinatorService
+
+    svc = CoordinatorService.__new__(CoordinatorService)
+    svc._lock = threading.Lock()
+    svc.metrics_snapshots = {1: {"m": 1}}
+    svc.load_snapshots = {1: {"q": 2}}
+    svc.flight_dumps = {1: {"spans": []}}
+
+    m = svc.metrics_snapshot_view()
+    assert m == {1: {"m": 1}}
+    m[2] = {}  # a copy: mutating the view must not touch the ledger
+    assert 2 not in svc.metrics_snapshots
+    assert svc.load_snapshot_view() == {1: {"q": 2}}
+    assert svc.flight_dump_view() == {1: {"spans": []}}
+
+
+def test_checkpoint_close_joins_outside_the_condition(tmp_path):
+    """close() used to read/join/null _thread off-lock — it now
+    captures-and-clears under _cv and joins outside (joining under _cv
+    would deadlock the writer's exit). Exercise a full save/close."""
+    from horovod_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save({"w": [1.0, 2.0]}, step=1)
+    mgr.wait()
+    mgr.close()
+    assert mgr._thread is None
+    with pytest.raises(Exception):
+        mgr.save({"w": [1.0]}, step=2)  # closed manager refuses work
+
+
+# ---------------------------------------------------------------------------
+# runtime lockdep sanitizer (horovod_tpu/utils/lockdep.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def lockdep_on(monkeypatch):
+    from horovod_tpu.utils import lockdep
+    monkeypatch.setenv("HVD_LOCKDEP", "1")
+    lockdep.reset()
+    yield lockdep
+    lockdep.reset()
+
+
+def test_lockdep_off_returns_raw_lock(monkeypatch):
+    from horovod_tpu.utils import lockdep
+    monkeypatch.delenv("HVD_LOCKDEP", raising=False)
+    raw = lockdep.lock("Anything._lock")
+    assert type(raw) is type(threading.Lock()), \
+        "HVD_LOCKDEP unset must yield a raw threading.Lock — zero " \
+        "instrumented code on the hot path"
+    rraw = lockdep.rlock("Anything._rlock")
+    assert type(rraw) is type(threading.RLock())
+
+
+def test_lockdep_self_deadlock_detected(lockdep_on):
+    a = lockdep_on.lock("T.a")
+    a.acquire()
+    # the second, would-hang acquire is probed non-blocking so the test
+    # itself cannot deadlock; _before_acquire runs either way
+    assert a.acquire(blocking=False) is False
+    a.release()
+    kinds = [f["kind"] for f in lockdep_on.findings()]
+    assert "self_deadlock" in kinds
+
+
+def test_lockdep_reentrant_lock_not_flagged(lockdep_on):
+    r = lockdep_on.rlock("T.r")
+    with r:
+        with r:
+            pass
+    assert lockdep_on.findings() == []
+
+
+def test_lockdep_rank_violation_against_contract(lockdep_on):
+    # real names from common/concurrency.py LOCK_RANKS: Tracer._lock is
+    # rank 40, CoordinatorService._lock rank 10 — taking the control-
+    # plane lock while holding an observability lock is the inversion
+    inner = lockdep_on.lock("Tracer._lock")
+    outer = lockdep_on.lock("CoordinatorService._lock")
+    with inner:
+        with outer:
+            pass
+    finds = [f for f in lockdep_on.findings()
+             if f["kind"] == "rank_violation"]
+    assert finds, "acquiring rank 10 under rank 40 must be reported"
+    assert finds[0]["lock_held"] == "Tracer._lock"
+    assert finds[0]["lock_acquiring"] == "CoordinatorService._lock"
+
+
+def test_lockdep_order_cycle_witnessed_across_threads(lockdep_on):
+    a = lockdep_on.lock("CycleTest.a")
+    b = lockdep_on.lock("CycleTest.b")
+
+    def a_then_b():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=a_then_b, name="witness-a-then-b")
+    t.start()
+    t.join()
+    # now the reverse order on this thread: no timing-dependent
+    # deadlock needed — the witnessed A->B edge closes the cycle
+    with b:
+        with a:
+            pass
+    cycles = [f for f in lockdep_on.findings()
+              if f["kind"] == "order_cycle"]
+    assert len(cycles) == 1, "one cycle, not one per direction"
+    c = cycles[0]
+    assert {c["lock_a"], c["lock_b"]} == {"CycleTest.a", "CycleTest.b"}
+    assert c["thread_a_then_b"] == "witness-a-then-b"
+    assert c["stack_a_then_b"] and c["stack_b_then_a"], \
+        "both witness stacks must ride the finding"
+
+
+def test_lockdep_findings_dedup_and_reset(lockdep_on):
+    a = lockdep_on.lock("Dedup.a")
+    b = lockdep_on.lock("Dedup.b")
+
+    def a_then_b():
+        with a:
+            with b:
+                pass
+
+    for _ in range(2):
+        t = threading.Thread(target=a_then_b)
+        t.start()
+        t.join()
+        with b:
+            with a:
+                pass
+    assert len(lockdep_on.findings()) == 1
+    lockdep_on.reset()
+    assert lockdep_on.findings() == []
+
+
+def test_lockdep_hold_while_blocking(lockdep_on, monkeypatch):
+    monkeypatch.setenv("HVD_LOCKDEP_STALL_S", "0.05")
+    held = lockdep_on.lock("Stall.held")
+    contended = lockdep_on.lock("Stall.contended")
+    release = threading.Event()
+
+    def hog():
+        with contended:
+            release.wait(5.0)
+
+    t = threading.Thread(target=hog)
+    t.start()
+    while not contended.locked():
+        pass
+    with held:
+        got = contended.acquire(blocking=True, timeout=0.2)
+        if got:
+            contended.release()
+    release.set()
+    t.join()
+    # a caller-supplied timeout bypasses the stall probe — re-run with
+    # a plain blocking acquire to hit it
+    t = threading.Thread(target=hog)
+    release.clear()
+    t.start()
+    while not contended.locked():
+        pass
+
+    def unblock():
+        release.set()
+
+    timer = threading.Timer(0.15, unblock)
+    timer.start()
+    with held:
+        with contended:
+            pass
+    t.join()
+    stalls = [f for f in lockdep_on.findings()
+              if f["kind"] == "hold_while_blocking"]
+    assert stalls, "blocking >stall_s while holding a lock must report"
+    assert stalls[0]["lock_blocked_on"] == "Stall.contended"
+    assert "Stall.held" in stalls[0]["locks_held"]
+
+
+# ---------------------------------------------------------------------------
+# the synthetic two-lock inversion drill: a witnessed inversion must
+# escalate through event -> warning -> flight dump, and hvd_postmortem
+# must name BOTH locks in its verdict from the dump alone.
+# ---------------------------------------------------------------------------
+
+def test_lockdep_inversion_flight_dump_names_both_locks(
+        lockdep_on, monkeypatch, tmp_path):
+    from horovod_tpu.utils import metrics as hvd_metrics
+    from horovod_tpu.utils import tracing as hvd_tracing
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    hvd_metrics.reset(enabled=True)
+    hvd_tracing.reset(enabled=True, rank=0)
+    try:
+        a = lockdep_on.lock("Drill.a")
+        b = lockdep_on.lock("Drill.b")
+
+        def a_then_b():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=a_then_b, name="drill-forward")
+        t.start()
+        t.join()
+        with b:
+            with a:
+                pass
+
+        dumps = glob.glob(os.path.join(str(tmp_path), "flight-rank*.json"))
+        assert dumps, "the inversion must write a flight dump"
+        with open(dumps[0]) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "lockdep_order_cycle"
+        evs = [e for e in dump.get("events", [])
+               if e.get("event") == "lockdep_order_cycle"]
+        assert evs, "the dump's event ring must carry the finding"
+        assert {evs[0]["lock_a"], evs[0]["lock_b"]} == \
+            {"Drill.a", "Drill.b"}
+        assert evs[0]["stack_a_then_b"] and evs[0]["stack_b_then_a"]
+
+        # postmortem end-to-end: the verdict names both locks + threads
+        import tools.hvd_postmortem as pm
+        loaded, bad = pm.load_dumps(dumps)
+        assert not bad
+        pm.rebase(loaded)
+        verdict = pm.analyze(loaded)
+        assert verdict["lockdep_findings"]
+        reason = "\n".join(verdict["reasons"])
+        assert "Drill.a" in reason and "Drill.b" in reason
+        assert "drill-forward" in reason
+    finally:
+        hvd_metrics.reset()
+        hvd_tracing.reset()
+
+
+def test_lockdep_finding_cap(monkeypatch):
+    from horovod_tpu.utils import lockdep
+    monkeypatch.setenv("HVD_LOCKDEP", "1")
+    monkeypatch.setenv("HVD_LOCKDEP_MAX_FINDINGS", "2")
+    lockdep.reset()
+    try:
+        for i in range(5):
+            li = lockdep.lock(f"Cap.lock{i}")
+            li.acquire()
+            li.acquire(blocking=False)
+            li.release()
+        assert len(lockdep.findings()) == 2
+    finally:
+        lockdep.reset()

@@ -439,6 +439,45 @@ def analyze(dumps):
                     f"capacity — OOM territory "
                     f"(components: {hbm.get('components')})")
 
+    # 10. concurrency plane (utils/lockdep.py, HVD_LOCKDEP=1): deadlock-
+    # shaped findings the runtime sanitizer witnessed. An order cycle
+    # names BOTH locks and carries BOTH witness stacks in the event
+    # payload, so "which two locks, taken where, by which threads" is
+    # answerable from the dumps alone.
+    lockdep_findings = []
+    for d in dumps:
+        for e in d.get("events", []):
+            kind = e.get("event") or ""
+            if not kind.startswith("lockdep_"):
+                continue
+            lockdep_findings.append({"dump_rank": _rank_of(d), **e})
+            if kind == "lockdep_order_cycle":
+                reasons.append(
+                    f"lockdep: lock-order cycle between "
+                    f"{e.get('lock_a')} and {e.get('lock_b')} — thread "
+                    f"'{e.get('thread_a_then_b')}' took "
+                    f"{e.get('lock_a')} then {e.get('lock_b')}, thread "
+                    f"'{e.get('thread')}' took them in reverse (both "
+                    f"witness stacks are in the event payload)")
+            elif kind == "lockdep_rank_violation":
+                reasons.append(
+                    f"lockdep: {e.get('lock_acquiring')} (rank "
+                    f"{e.get('rank_acquiring')}) acquired while holding "
+                    f"{e.get('lock_held')} (rank {e.get('rank_held')}) "
+                    f"on thread '{e.get('thread')}' — against the "
+                    f"LOCK_RANKS order (common/concurrency.py)")
+            elif kind == "lockdep_self_deadlock":
+                reasons.append(
+                    f"lockdep: thread '{e.get('thread')}' re-entered "
+                    f"non-reentrant lock {e.get('lock')} — a guaranteed "
+                    f"hang caught before it blocked")
+            elif kind == "lockdep_hold_while_blocking":
+                reasons.append(
+                    f"lockdep: thread '{e.get('thread')}' held "
+                    f"[{e.get('locks_held')}] while blocked longer than "
+                    f"{e.get('stall_s')}s acquiring "
+                    f"{e.get('lock_blocked_on')}")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -497,6 +536,7 @@ def analyze(dumps):
         "recompile_storms": recompile_storms,
         "resharding_findings": resharding_findings,
         "memory_by_rank": memory_by_rank,
+        "lockdep_findings": lockdep_findings,
     }
 
 
@@ -595,6 +635,11 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
         finds = [(e.get("leaf"), e.get("op"), e.get("axis"))
                  for e in verdict["resharding_findings"]]
         lines.append(f"  resharding     : {finds}")
+    if verdict.get("lockdep_findings"):
+        kinds = collections.Counter(
+            (e.get("event") or "")[len("lockdep_"):]
+            for e in verdict["lockdep_findings"])
+        lines.append(f"  lockdep        : {dict(kinds)}")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -616,6 +661,22 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
                 f"  {e.get('anomaly')}: tensor '{e.get('tensor')}' "
                 f"cycle {e.get('cycle')} blamed rank {blamed} "
                 f"(trace {e.get('trace_id')})")
+
+    if verdict.get("lockdep_findings"):
+        lines.append("")
+        lines.append("-- lockdep findings (HVD_LOCKDEP sanitizer) " + "-" * 28)
+        for e in verdict["lockdep_findings"][:8]:
+            kind = (e.get("event") or "")[len("lockdep_"):]
+            locks = {k: v for k, v in sorted(e.items())
+                     if k.startswith("lock")}
+            lines.append(
+                f"  {kind}: rank {e.get('dump_rank')}, thread "
+                f"'{e.get('thread')}' — {locks}")
+            for sk in ("stack_a_then_b", "stack_b_then_a", "stack"):
+                if e.get(sk):
+                    lines.append(f"    {sk}:")
+                    for ln in str(e[sk]).rstrip().splitlines()[-6:]:
+                        lines.append(f"      {ln.rstrip()}")
 
     if verdict["waiting"]:
         lines.append("")
@@ -657,23 +718,26 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
     ev = []
     for d in dumps:
         for e in d.get("events", []):
-            if e.get("event") in ("stall", "stall_kill", "ranks_lost",
-                                  "chaos_injection", "slow_span",
-                                  "numerics_anomaly", "serve_failover",
-                                  "slow_decode_tick", "fleet_publish",
-                                  "fleet_swap", "fleet_refuse",
-                                  "ckpt_preempt", "ckpt_emergency_exit",
-                                  "route_replica_lost", "route_reroute",
-                                  "route_canary_begin", "route_promote",
-                                  "route_rollback", "recompile_storm",
-                                  "resharding_finding"):
+            kind = e.get("event") or ""
+            if kind in ("stall", "stall_kill", "ranks_lost",
+                        "chaos_injection", "slow_span",
+                        "numerics_anomaly", "serve_failover",
+                        "slow_decode_tick", "fleet_publish",
+                        "fleet_swap", "fleet_refuse",
+                        "ckpt_preempt", "ckpt_emergency_exit",
+                        "route_replica_lost", "route_reroute",
+                        "route_canary_begin", "route_promote",
+                        "route_rollback", "recompile_storm",
+                        "resharding_finding") or \
+                    kind.startswith("lockdep_"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
         lines.append("-- escalation events (all ranks, merged) " + "-" * 31)
         for t, rank, e in sorted(ev, key=lambda x: x[0])[-20:]:
             detail = {k: v for k, v in e.items()
-                      if k not in ("event", "ts_us", "epoch_us", "t_us")}
+                      if k not in ("event", "ts_us", "epoch_us", "t_us")
+                      and not k.startswith("stack")}
             lines.append(f"  [{_fmt_us(t)}] rank {rank} "
                          f"{e.get('event')}: {detail}")
     lines.append("")
@@ -716,7 +780,7 @@ def chrome_trace(dumps, stitched):
                 "tid": lanes.get(s.get("stage"), 0),
                 "args": {"trace_id": s.get("trace_id")}})
         for e in d.get("events", []):
-            kind = e.get("event")
+            kind = e.get("event") or ""
             if kind in ("stall", "stall_kill", "ranks_lost",
                         "chaos_injection", "numerics_anomaly",
                         "serve_failover", "fleet_publish", "fleet_swap",
@@ -724,7 +788,8 @@ def chrome_trace(dumps, stitched):
                         "ckpt_emergency_exit", "route_replica_lost",
                         "route_reroute", "route_canary_begin",
                         "route_promote", "route_rollback",
-                        "recompile_storm", "resharding_finding"):
+                        "recompile_storm", "resharding_finding") or \
+                    kind.startswith("lockdep_"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

@@ -15,6 +15,8 @@ from .engine import analyze_paths, render_baseline
 DEFAULT_PATHS = ["horovod_tpu", "tools", "bench.py", "examples"]
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+CONCURRENCY_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "concurrency_baseline.json")
 
 
 def _build_parser():
@@ -46,11 +48,20 @@ def _build_parser():
     p.add_argument("--check-envdoc", action="store_true",
                    help="fail (exit 1) if docs/envvars.md drifted from "
                         "ENV_REGISTRY")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the whole-program lock-discipline pass "
+                        "(HVD021/HVD022) instead of the per-file rules; "
+                        "baseline defaults to concurrency_baseline.json")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the concurrency pass over embedded "
+                        "fixtures with known verdicts and exit — the "
+                        "CI smoke that a crash in the pass fails loud")
     return p
 
 
 def _explain(code):
     from .rules import RULES
+    from .concurrency import EXPLAIN as CONCURRENCY_EXPLAIN
     code = code.upper()
     if code == "HVD000":
         print("HVD000 — lint integrity\n\nNot a code rule: reports "
@@ -58,6 +69,9 @@ def _explain(code):
               "do not parse, reasonless `# hvdlint: disable=` "
               "comments, baseline entries with no reason, and stale "
               "baseline entries whose violation no longer exists.")
+        return 0
+    if code in CONCURRENCY_EXPLAIN:
+        print(CONCURRENCY_EXPLAIN[code])
         return 0
     rule = RULES.get(code)
     if rule is None:
@@ -90,6 +104,26 @@ def main(argv=None):
               f"({len(entries)} variables)")
         return 0
 
+    if args.selftest:
+        from .concurrency import selftest
+        problem = selftest()
+        if problem:
+            print(f"hvdlint: {problem}", file=sys.stderr)
+            return 1
+        print("hvdlint: concurrency selftest passed "
+              "(HVD021+HVD022 fire on the bad fixture, "
+              "clean fixture stays clean)")
+        return 0
+
+    program_pass = None
+    rules = None
+    if args.concurrency:
+        from .concurrency import run_pass
+        program_pass = run_pass
+        rules = {}  # the per-file rules run in the default invocation
+        if args.baseline == DEFAULT_BASELINE:
+            args.baseline = CONCURRENCY_BASELINE
+
     paths = args.paths or DEFAULT_PATHS
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
@@ -99,7 +133,9 @@ def main(argv=None):
     baseline = None if args.baseline == "none" else args.baseline
 
     if args.write_baseline:
-        findings, _ = analyze_paths(paths, baseline_path=None)
+        findings, _ = analyze_paths(paths, baseline_path=None,
+                                    rules=rules,
+                                    program_pass=program_pass)
         live = [f for f in findings if not f.suppressed]
         data = render_baseline(live)
         with open(args.baseline, "w", encoding="utf-8") as f:
@@ -110,7 +146,9 @@ def main(argv=None):
               "empty \"reason\"")
         return 0
 
-    findings, files = analyze_paths(paths, baseline_path=baseline)
+    findings, files = analyze_paths(paths, baseline_path=baseline,
+                                    rules=rules,
+                                    program_pass=program_pass)
     live = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
 

@@ -1,0 +1,935 @@
+"""hvdlint ``--concurrency``: whole-program lock-discipline analysis.
+
+Two rules, both driven by the annotation convention
+``horovod_tpu/common/concurrency.py`` defines (docs/concurrency.md):
+
+  HVD021  guarded-by violation — an attribute declared
+          ``# guarded_by: <lock>`` (or registered in the GUARDED table)
+          is read or written outside a ``with <lock>:`` scope. The
+          check is interprocedural within a class: a private helper
+          whose every intra-class call site holds the lock counts as
+          locked ("lock held by caller", the RacerD ownership idiom),
+          and the finding names the thread entry the access is
+          reachable from when there is one.
+
+  HVD022  lock-order violation — a scope already holding lock A
+          acquires lock B where (a) B *is* A and A is non-reentrant
+          (the metrics-registry ``reset()`` self-deadlock class), or
+          (b) both locks carry declared ranks (LOCK_RANKS, or a
+          per-file ``# lock_rank: name = N`` comment) and
+          ``rank(B) <= rank(A)`` — an inversion against the one global
+          order. Nested acquisition is tracked lexically and one call
+          level deep through same-class/same-module helpers.
+
+Unlike the per-file rules in rules.py, this pass sees the WHOLE module
+set at once: the thread-entry set (every ``threading.Thread(target=…)``,
+``atexit``/``signal`` callback, and Thread-subclass ``run``) is built
+globally, and the GUARDED/LOCK_RANKS tables are parsed — never
+imported — from common/concurrency.py.
+"""
+
+import ast
+import re
+
+from .engine import Finding
+
+CONTRACT_SUFFIX = "horovod_tpu/common/concurrency.py"
+
+_GUARD_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_RANK_RE = re.compile(
+    r"^\s*#\s*lock_rank:\s*([A-Za-z_][A-Za-z0-9_.]*)\s*=\s*(-?\d+)\s*$")
+
+# __init__ and friends run before the object is shared; accesses there
+# are construction, not races. __del__/__exit__-style teardown still
+# races with live threads, so only true pre-publication methods exempt.
+_CONSTRUCTION_METHODS = {"__init__", "__new__", "__post_init__"}
+
+EXPLAIN = {
+    "HVD021": """\
+HVD021 — guarded-by violation (off-lock access to shared state)
+
+Every long-lived background thread in this framework shares state with
+its frontends through one mutex — the reference design's
+mutex-guarded message queue shape, re-created per plane ~10 times.
+An attribute annotated ``# guarded_by: <lock>`` (or registered in
+common/concurrency.py GUARDED) must only be read or written inside a
+``with <lock>:`` scope. A private helper whose every intra-class call
+site holds the lock is treated as locked; everything else — public
+methods, thread entries, module functions — must take the lock at the
+access.
+
+History: the fleet poll/GC TOCTOU, the shm_ring lost-wake, and the
+metrics registry's torn snapshot reads were all off-lock accesses to
+state a lock nominally owned; each was caught dynamically, after the
+fact, by a chaos drill. This rule catches the shape at lint time.
+
+Fix: take the lock (or widen an existing scope); for a deliberate
+lock-free fast path (double-checked init, torn-read-tolerant gauge
+reads) add ``# hvdlint: disable=HVD021(reason)`` or a reasoned
+baseline entry — the reason is the contract.""",
+    "HVD022": """\
+HVD022 — lock-order violation (static inversion against LOCK_RANKS)
+
+common/concurrency.py declares the ONE global lock order as integer
+ranks: holding a lock, you may only acquire locks of strictly greater
+rank. This rule reports (a) re-acquisition of a held non-reentrant
+lock — the metrics-registry reset() self-deadlock class — and (b) any
+nested acquisition where both locks are ranked and the inner rank is
+not strictly greater, i.e. a path that, run concurrently with the
+declared order, deadlocks.
+
+Nesting is tracked lexically plus one call level through same-class /
+same-module helpers, so ``with self._lock: self._helper()`` sees the
+locks the helper takes. Locks outside the table are unranked — the
+runtime sanitizer (HVD_LOCKDEP=1, utils/lockdep.py) still witnesses
+their real orders and reports cycles.
+
+Fix: re-order the acquisitions to match the table, or split the work
+so the inner lock is taken after the outer is released; if the table
+itself is wrong, re-rank with a PR that re-runs this pass.""",
+}
+
+SUMMARY = {
+    "HVD021": "guarded attribute read/written off-lock",
+    "HVD022": "lock acquired against the declared rank order "
+              "(or re-acquired while held)",
+}
+
+
+# ---------------------------------------------------------------------------
+# contract tables (parsed, never imported)
+# ---------------------------------------------------------------------------
+
+def load_contract(ctxs):
+    """(lock_ranks, guarded) from common/concurrency.py when it is in
+    the scanned set; empty tables otherwise (fixture runs)."""
+    for ctx in ctxs:
+        if ctx.relpath.endswith(CONTRACT_SUFFIX):
+            return _parse_contract(ctx.tree)
+    return {}, ()
+
+
+def _parse_contract(tree):
+    ranks, guarded = {}, ()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            try:
+                if t.id == "LOCK_RANKS":
+                    ranks = dict(ast.literal_eval(node.value))
+                elif t.id == "GUARDED":
+                    guarded = tuple(ast.literal_eval(node.value))
+            except (ValueError, SyntaxError):
+                pass
+    return ranks, guarded
+
+
+# ---------------------------------------------------------------------------
+# per-module model
+# ---------------------------------------------------------------------------
+
+class _ClassModel:
+    def __init__(self, name, node):
+        self.name = name
+        self.node = node
+        self.guards = {}   # attr -> lock token (bare name, e.g. "_lock")
+        self.locks = {}    # attr -> "lock" | "rlock" | "cond"
+        self.methods = {}  # name -> FunctionDef
+        self.thread_subclass = False
+
+
+class _ModuleModel:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.basename = ctx.relpath.rsplit("/", 1)[-1][:-3]
+        self.classes = {}        # name -> _ClassModel
+        self.funcs = {}          # module-level name -> FunctionDef
+        self.guards = {}         # module global -> lock token
+        self.locks = {}          # module lock name -> kind
+        self.local_ranks = {}    # lock name (as written) -> rank
+        self._scan()
+
+    def _scan(self):
+        ctx = self.ctx
+        for i, text in enumerate(ctx.lines, start=1):
+            m = _RANK_RE.match(text)
+            if m:
+                self.local_ranks[m.group(1)] = int(m.group(2))
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cm = _ClassModel(node.name, node)
+                cm.thread_subclass = any(
+                    ("Thread" in _dotted(b)) for b in node.bases)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        cm.methods[sub.name] = sub
+                self.classes[node.name] = cm
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[node.name] = node
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._module_assign(node)
+        # attribute guards + lock defs live on `self.X = ...` lines in
+        # any method (canonically __init__)
+        for cm in self.classes.values():
+            for meth in cm.methods.values():
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AnnAssign) and \
+                            node.value is not None:
+                        targets, value = [node.target], node.value
+                    else:
+                        continue
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        guard = self._guard_comment(node.lineno)
+                        if guard:
+                            cm.guards.setdefault(attr, guard)
+                        kind = _lock_kind(value)
+                        if kind:
+                            cm.locks.setdefault(attr, kind)
+
+    def _module_assign(self, node):
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        value = node.value
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            guard = self._guard_comment(node.lineno)
+            if guard:
+                self.guards.setdefault(t.id, guard)
+            kind = _lock_kind(value) if value is not None else None
+            if kind:
+                self.locks.setdefault(t.id, kind)
+
+    def _guard_comment(self, lineno):
+        """Trailing comment on the assignment line, or a standalone
+        comment line directly above it (for multi-line assignments) —
+        the same two placements engine suppressions accept."""
+        idx = lineno - 1
+        if 0 <= idx < len(self.ctx.lines):
+            m = _GUARD_RE.search(self.ctx.lines[idx])
+            if m:
+                return m.group(1)
+        above = idx - 1
+        if 0 <= above < len(self.ctx.lines) and \
+                self.ctx.lines[above].lstrip().startswith("#"):
+            m = _GUARD_RE.search(self.ctx.lines[above])
+            if m:
+                return m.group(1)
+        return None
+
+    def rank_of(self, token, cls_name):
+        """Declared rank for a held-lock token, or None. Tries the
+        qualified spelling first (Class.attr / module.global), then the
+        file's own # lock_rank: declarations, then the bare token."""
+        keys = []
+        if cls_name:
+            keys.append(f"{cls_name}.{token}")
+        keys.append(f"{self.basename}.{token}")
+        keys.append(token)
+        for k in keys:
+            if k in self.local_ranks:
+                return self.local_ranks[k]
+            if k in self._global_ranks:
+                return self._global_ranks[k]
+        return None
+
+    _global_ranks = {}  # set by run_pass
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _self_attr(node):
+    """'X' for a `self.X` target/expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_kind(value):
+    """threading.Lock()/RLock()/Condition(...) or lockdep.lock()/
+    rlock() construction -> kind, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func)
+    tail = name.rsplit(".", 1)[-1]
+    if name.startswith("threading.") or name in ("Lock", "RLock",
+                                                 "Condition"):
+        return {"Lock": "lock", "RLock": "rlock",
+                "Condition": "cond"}.get(tail)
+    if tail == "lock" and "lockdep" in name:
+        for kw in value.keywords:
+            if kw.arg == "reentrant" and \
+                    isinstance(kw.value, ast.Constant) and kw.value.value:
+                return "rlock"
+        return "lock"
+    if tail == "rlock" and "lockdep" in name:
+        return "rlock"
+    return None
+
+
+def _lock_token(expr):
+    """The held-set token an acquired expression maps to: `self._lock`
+    -> '_lock', module-global `_registry_lock` -> '_registry_lock'."""
+    attr = _self_attr(expr)
+    if attr is not None:
+        return attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# thread-entry set (whole program)
+# ---------------------------------------------------------------------------
+
+def _thread_roots(models):
+    """{(relpath, class_or_None, func)} for every thread/callback entry:
+    threading.Thread(target=...), atexit.register/signal.signal
+    callbacks, and run() of threading.Thread subclasses."""
+    roots = set()
+    for mod in models:
+        ctx = mod.ctx
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            tail = name.rsplit(".", 1)[-1]
+            cands = []
+            if tail == "Thread":
+                cands = [kw.value for kw in node.keywords
+                         if kw.arg == "target"]
+            elif name in ("atexit.register", "signal.signal",
+                          "register"):
+                cands = list(node.args)
+            for cand in cands:
+                attr = _self_attr(cand)
+                if attr is not None:
+                    cls = _owner_class(node, mod)
+                    if cls is not None:
+                        roots.add((ctx.relpath, cls, attr))
+                elif isinstance(cand, ast.Name):
+                    roots.add((ctx.relpath, None, cand.id))
+        for cname, cm in mod.classes.items():
+            if cm.thread_subclass and "run" in cm.methods:
+                roots.add((ctx.relpath, cname, "run"))
+    return roots
+
+
+def _owner_class(node, mod):
+    cur = getattr(node, "hvdlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = getattr(cur, "hvdlint_parent", None)
+    return None
+
+
+def _reachable(models, roots):
+    """Transitive closure of the thread-entry set through same-class
+    self-calls and same-module bare calls. Returns
+    {(relpath, cls_or_None, func): root_name}."""
+    by_file = {m.ctx.relpath: m for m in models}
+    reach = {}
+    work = []
+    for key in roots:
+        reach[key] = _root_label(key)
+        work.append(key)
+    while work:
+        relpath, cls, fname = work.pop()
+        mod = by_file.get(relpath)
+        if mod is None:
+            continue
+        func = None
+        if cls is not None:
+            cm = mod.classes.get(cls)
+            func = cm.methods.get(fname) if cm else None
+        else:
+            func = mod.funcs.get(fname)
+        if func is None:
+            continue
+        label = reach[(relpath, cls, fname)]
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _self_attr(node.func)
+            if attr is not None and cls is not None and \
+                    attr in mod.classes[cls].methods:
+                key = (relpath, cls, attr)
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in mod.funcs:
+                key = (relpath, None, node.func.id)
+            else:
+                continue
+            if key not in reach:
+                reach[key] = label
+                work.append(key)
+    return reach
+
+
+def _root_label(key):
+    relpath, cls, fname = key
+    return f"{cls}.{fname}" if cls else fname
+
+
+# ---------------------------------------------------------------------------
+# the lock-scope walker
+# ---------------------------------------------------------------------------
+
+class _ScopeWalker:
+    """Walks one function tracking the lexically held lock-token set;
+    invokes callbacks at guarded-attribute accesses, lock acquisitions,
+    and intra-scope calls."""
+
+    def __init__(self, on_access, on_acquire, on_call):
+        self.on_access = on_access
+        self.on_acquire = on_acquire
+        self.on_call = on_call
+
+    def walk(self, func, entry_held):
+        self._visit_body(func.body, frozenset(entry_held))
+
+    def _visit_body(self, body, held):
+        for node in body:
+            self._visit(node, held)
+
+    def _visit(self, node, held):
+        if isinstance(node, ast.With):
+            new = []
+            for item in node.items:
+                tok = _lock_token(item.context_expr)
+                if tok is not None:
+                    self.on_acquire(tok, held | frozenset(new), node)
+                    new.append(tok)
+                else:
+                    self._visit(item.context_expr, held)
+            inner = held | frozenset(new)
+            self._visit_body(node.body, inner)
+            return
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.endswith(".acquire"):
+                tok = _lock_token(node.func.value)
+                # sticky acquire()-style locks are already in the held
+                # set for the whole body; re-reporting the acquire call
+                # itself would flag every try/finally idiom
+                if tok is not None and tok not in held:
+                    self.on_acquire(tok, held, node)
+            self.on_call(node, held)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure inherits the held set at its definition point —
+            # conservative for callbacks stored and run later, but the
+            # common local-helper / key-function case reads naturally
+            body = node.body if isinstance(node.body, list) else \
+                [ast.Expr(node.body)]
+            self._visit_body(body, held)
+            return
+        attr = _self_attr(node)
+        if attr is not None:
+            self.on_access(node, attr, held)
+            self._visit(node.value, held)
+            return
+        if isinstance(node, ast.Name):
+            self.on_access(node, None, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def run_pass(ctxs, shared=None):
+    """The --concurrency engine pass: HVD021 + HVD022 findings over the
+    whole module set."""
+    lock_ranks, guarded = load_contract(ctxs)
+    _ModuleModel._global_ranks = lock_ranks
+    models = [_ModuleModel(ctx) for ctx in ctxs]
+    for mod in models:
+        for (suffix, cls, attr, lock) in guarded:
+            if not mod.ctx.relpath.endswith(suffix):
+                continue
+            if cls is None:
+                mod.guards.setdefault(attr, lock)
+            elif cls in mod.classes:
+                mod.classes[cls].guards.setdefault(attr, lock)
+    roots = _thread_roots(models)
+    reach = _reachable(models, roots)
+    # GUARDED class attributes are enforced EVERYWHERE, not just in the
+    # owning class: any `<expr>.attr` in a foreign scope must sit under
+    # `with <expr>.<lock>:` (or go through a locked accessor).
+    cross_guards = {attr: (cls, lock)
+                    for (_suffix, cls, attr, lock) in guarded
+                    if cls is not None}
+
+    findings = []
+    for mod in models:
+        findings.extend(_check_module(mod, reach))
+        if cross_guards:
+            findings.extend(_check_cross_guards(mod, cross_guards))
+    return findings
+
+
+def _check_cross_guards(mod, cross_guards):
+    """Off-lock access to another object's GUARDED attribute:
+    ``svc.metrics_snapshots`` outside ``with svc._lock:``. Held locks
+    are tracked as full dotted spellings, so aliasing through a
+    different name is (correctly) not credited."""
+    findings = []
+    relpath = mod.ctx.relpath
+    seen = set()
+
+    def visit(node, held, owner_cls):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                visit(sub, held, node.name)
+            return
+        if isinstance(node, ast.With):
+            toks = set()
+            for item in node.items:
+                name = _dotted(item.context_expr)
+                if name:
+                    toks.add(name)
+                visit(item.context_expr, held, owner_cls)
+            inner = held | frozenset(toks)
+            for sub in node.body:
+                visit(sub, inner, owner_cls)
+            return
+        if isinstance(node, ast.Attribute) and node.attr in cross_guards:
+            cls, lock = cross_guards[node.attr]
+            base = _dotted(node.value)
+            if base and owner_cls != cls:
+                need = f"{base}.{lock}"
+                key = (node.lineno, node.col_offset, node.attr)
+                if need not in held and key not in seen:
+                    seen.add(key)
+                    mode = "written" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "read"
+                    findings.append(Finding(
+                        "HVD021", relpath, node.lineno,
+                        node.col_offset,
+                        f"'{base}.{node.attr}' is {cls} ledger state "
+                        f"guarded by {cls}.{lock} "
+                        f"(common/concurrency.py GUARDED) but is "
+                        f"{mode} here off-lock — a cross-thread torn "
+                        f"read/write. Use a locked snapshot accessor "
+                        f"on {cls}, or take `with {base}.{lock}:`."))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, owner_cls)
+
+    for node in mod.ctx.tree.body:
+        visit(node, frozenset(), None)
+    return findings
+
+
+def _sticky_tokens(func, known):
+    """Lock tokens .acquire()d anywhere in the function (the
+    try/finally acquire-release idiom): treated as held for the whole
+    body — a deliberate over-approximation on the pre-acquire prefix."""
+    out = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and \
+                _dotted(node.func).endswith(".acquire"):
+            tok = _lock_token(node.func.value)
+            if tok is not None and tok in known:
+                out.add(tok)
+    return out
+
+
+def _check_module(mod, reach):
+    findings = []
+    for cname, cm in mod.classes.items():
+        if not (cm.guards or cm.locks):
+            continue
+        findings.extend(_check_class(mod, cname, cm, reach))
+
+    if mod.guards or mod.locks:
+        findings.extend(_check_module_scope(mod, reach))
+    return findings
+
+
+def _known_locks(mod, cm):
+    known = set(cm.locks) | set(mod.locks)
+    known.update(cm.guards.values())
+    known.update(mod.guards.values())
+    return known
+
+
+def _check_class(mod, cname, cm, reach):
+    relpath = mod.ctx.relpath
+    known = _known_locks(mod, cm)
+    sticky = {name: _sticky_tokens(fn, known)
+              for name, fn in cm.methods.items()}
+
+    # -- interprocedural entry-held fixpoint ---------------------------
+    roots_set = _as_roots(reach)
+    entry_held = {name: frozenset() for name in cm.methods}
+    for _ in range(3):
+        callsites = {}  # method -> list of held frozensets at its calls
+
+        def on_call(node, held, _cs=callsites):
+            attr = _self_attr(node.func)
+            if attr is not None and attr in cm.methods:
+                _cs.setdefault(attr, []).append(held)
+
+        walker = _ScopeWalker(lambda *a: None, lambda *a: None, on_call)
+        for name, fn in cm.methods.items():
+            walker.walk(fn, entry_held[name] | sticky[name])
+        new = {}
+        for name in cm.methods:
+            # only private helpers inherit "lock held by caller"; public
+            # API, construction, and thread entries start lock-free
+            if not name.startswith("_") or \
+                    name in _CONSTRUCTION_METHODS or \
+                    (relpath, cname, name) in roots_set:
+                new[name] = frozenset()
+                continue
+            sites = callsites.get(name)
+            if sites:
+                common = frozenset.intersection(*map(frozenset, sites))
+                new[name] = common
+            else:
+                new[name] = frozenset()
+        if new == entry_held:
+            break
+        entry_held = new
+
+    # -- lock-acquisition closure (for one-call-deep HVD022) -----------
+    acquires = {}
+    for name, fn in cm.methods.items():
+        toks = set(sticky[name])
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    tok = _lock_token(item.context_expr)
+                    if tok is not None and tok in known:
+                        toks.add(tok)
+        acquires[name] = toks
+
+    # -- the real walk --------------------------------------------------
+    findings = []
+    seen = set()
+
+    def kind_of(tok):
+        return cm.locks.get(tok) or mod.locks.get(tok)
+
+    def order_check(tok, held, node, via=""):
+        for h in held:
+            if h == tok:
+                if kind_of(tok) != "rlock":
+                    key = ("re", node.lineno, node.col_offset, tok)
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(Finding(
+                            "HVD022", relpath, node.lineno,
+                            node.col_offset,
+                            f"non-reentrant lock '{tok}' acquired"
+                            f"{via} while already held in this scope: "
+                            "guaranteed self-deadlock (the "
+                            "metrics-registry reset() bug class)."))
+                continue
+            rh = mod.rank_of(h, cname)
+            rt = mod.rank_of(tok, cname)
+            if rh is not None and rt is not None and rt <= rh:
+                key = ("rank", node.lineno, node.col_offset, h, tok)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(Finding(
+                        "HVD022", relpath, node.lineno, node.col_offset,
+                        f"lock '{tok}' (rank {rt}) acquired{via} while "
+                        f"holding '{h}' (rank {rh}): inversion against "
+                        "the declared lock order "
+                        "(common/concurrency.py LOCK_RANKS) — a "
+                        "concurrent thread taking the declared order "
+                        "deadlocks against this path."))
+
+    cur_method = [None]
+
+    def on_access(node, attr, held):
+        if attr is None:
+            return
+        guard = cm.guards.get(attr)
+        if guard is None or guard in held:
+            return
+        meth = cur_method[0]
+        if meth in _CONSTRUCTION_METHODS:
+            return
+        key = ("acc", node.lineno, node.col_offset, attr)
+        if key in seen:
+            return
+        seen.add(key)
+        mode = "written" if isinstance(node.ctx,
+                                       (ast.Store, ast.Del)) else "read"
+        rkey = (relpath, cname, meth)
+        where = reach.get(rkey)
+        thread_note = (f"; reachable from thread entry '{where}'"
+                       if where else "")
+        findings.append(Finding(
+            "HVD021", relpath, node.lineno, node.col_offset,
+            f"'self.{attr}' (guarded_by: {guard}) {mode} off-lock in "
+            f"{cname}.{meth}{thread_note}. Take `with self.{guard}:` "
+            "around the access, or disable/baseline with the reason "
+            "the lock-free path is safe."))
+
+    def on_acquire(tok, held, node):
+        if tok in known:
+            order_check(tok, held, node)
+
+    def on_call(node, held):
+        attr = _self_attr(node.func)
+        if attr is not None and attr in cm.methods and held:
+            for tok in acquires.get(attr, ()):
+                order_check(tok, held, node,
+                            via=f" via self.{attr}()")
+
+    walker = _ScopeWalker(on_access, on_acquire, on_call)
+    for name, fn in cm.methods.items():
+        cur_method[0] = name
+        walker.walk(fn, entry_held[name] | sticky[name])
+    return findings
+
+
+def _as_roots(reach):
+    # reach maps every reachable function; roots are the ones mapping
+    # to their own label
+    return {k for k, v in reach.items() if _root_label(k) == v}
+
+
+def _check_module_scope(mod, reach):
+    """Module-level guarded globals + lock ordering in module funcs."""
+    relpath = mod.ctx.relpath
+    findings = []
+    seen = set()
+    known = set(mod.locks) | set(mod.guards.values())
+
+    acquires = {}
+    for name, fn in mod.funcs.items():
+        toks = _sticky_tokens(fn, known)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    tok = _lock_token(item.context_expr)
+                    if tok is not None and tok in known:
+                        toks.add(tok)
+        acquires[name] = toks
+
+    for fname, fn in mod.funcs.items():
+        local_binds = _local_binds(fn)
+        has_global = _global_decls(fn)
+
+        def on_access(node, attr, held, _f=fname, _lb=local_binds,
+                      _g=has_global):
+            if attr is not None or not isinstance(node, ast.Name):
+                return
+            name = node.id
+            guard = mod.guards.get(name)
+            if guard is None or guard in held:
+                return
+            if name not in _g and name in _lb:
+                return  # shadowed local
+            key = ("macc", node.lineno, node.col_offset, name)
+            if key in seen:
+                return
+            seen.add(key)
+            mode = "written" if isinstance(node.ctx, (ast.Store,
+                                                      ast.Del)) else "read"
+            rkey = (relpath, None, _f)
+            where = reach.get(rkey)
+            thread_note = (f"; reachable from thread entry '{where}'"
+                           if where else "")
+            findings.append(Finding(
+                "HVD021", relpath, node.lineno, node.col_offset,
+                f"module global '{name}' (guarded_by: {guard}) {mode} "
+                f"off-lock in {_f}(){thread_note}. Take `with "
+                f"{guard}:` around the access, or disable/baseline "
+                "with the reason the lock-free path is safe."))
+
+        def on_acquire(tok, held, node):
+            if tok not in known:
+                return
+            for h in held:
+                if h == tok:
+                    if mod.locks.get(tok) != "rlock":
+                        key = ("re", node.lineno, node.col_offset, tok)
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(Finding(
+                                "HVD022", relpath, node.lineno,
+                                node.col_offset,
+                                f"non-reentrant lock '{tok}' acquired "
+                                "while already held in this scope: "
+                                "guaranteed self-deadlock (the "
+                                "metrics-registry reset() bug class)."))
+                    continue
+                rh, rt = mod.rank_of(h, None), mod.rank_of(tok, None)
+                if rh is not None and rt is not None and rt <= rh:
+                    key = ("rank", node.lineno, node.col_offset, h, tok)
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(Finding(
+                            "HVD022", relpath, node.lineno,
+                            node.col_offset,
+                            f"lock '{tok}' (rank {rt}) acquired while "
+                            f"holding '{h}' (rank {rh}): inversion "
+                            "against the declared lock order."))
+
+        def on_call(node, held):
+            if not held or not isinstance(node.func, ast.Name):
+                return
+            callee = node.func.id
+            if callee in mod.funcs:
+                for tok in acquires.get(callee, ()):
+                    if tok in held and mod.locks.get(tok) != "rlock":
+                        key = ("recall", node.lineno, node.col_offset,
+                               tok)
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(Finding(
+                                "HVD022", relpath, node.lineno,
+                                node.col_offset,
+                                f"call to '{callee}()' while holding "
+                                f"non-reentrant lock '{tok}', which it "
+                                "acquires again: self-deadlock — the "
+                                "exact metrics-registry reset() shape."))
+                    else:
+                        rh = [mod.rank_of(h, None) for h in held]
+                        rt = mod.rank_of(tok, None)
+                        if rt is not None and any(
+                                r is not None and rt <= r for r in rh):
+                            key = ("rankcall", node.lineno,
+                                   node.col_offset, tok)
+                            if key not in seen:
+                                seen.add(key)
+                                findings.append(Finding(
+                                    "HVD022", relpath, node.lineno,
+                                    node.col_offset,
+                                    f"call to '{callee}()' acquires "
+                                    f"lock '{tok}' against the "
+                                    "declared rank order while locks "
+                                    "are held here."))
+
+        walker = _ScopeWalker(on_access, on_acquire, on_call)
+        walker.walk(fn, _sticky_tokens(fn, known))
+    return findings
+
+
+def _local_binds(func):
+    binds = set(a.arg for a in func.args.args +
+                func.args.kwonlyargs + func.args.posonlyargs)
+    if func.args.vararg:
+        binds.add(func.args.vararg.arg)
+    if func.args.kwarg:
+        binds.add(func.args.kwarg.arg)
+    for node in ast.walk(func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not func:
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            binds.add(node.id)
+        elif isinstance(node, ast.withitem) and \
+                isinstance(node.optional_vars, ast.Name):
+            binds.add(node.optional_vars.id)
+    return binds
+
+
+def _global_decls(func):
+    out = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# selftest — a crash in this pass must fail CI loud, not skip silently
+# ---------------------------------------------------------------------------
+
+_SELFTEST_BAD = '''\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._outer = threading.Lock()
+        self._value = 0   # guarded_by: _lock
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self._value += 1
+
+    def peek(self):
+        with self._lock:
+            return self._value
+
+    def inverted(self):
+        with self._lock:
+            with self._outer:
+                pass
+
+# lock_rank: Box._outer = 10
+# lock_rank: Box._lock = 20
+'''
+
+_SELFTEST_CLEAN = '''\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0   # guarded_by: _lock
+
+    def peek(self):
+        with self._lock:
+            return self._value
+
+    def _bump(self):
+        self._value += 1  # callers hold _lock
+
+    def bump(self):
+        with self._lock:
+            self._bump()
+'''
+
+
+def selftest():
+    """Run the pass over embedded fixtures with known verdicts. Returns
+    None on success, an error string on any mismatch — the CI smoke
+    that a crash or a silently-dead pass fails loud."""
+    from .engine import FileContext
+    bad = FileContext("selftest_bad.py", _SELFTEST_BAD)
+    clean = FileContext("selftest_clean.py", _SELFTEST_CLEAN)
+    findings = run_pass([bad, clean])
+    rules = sorted({f.rule for f in findings
+                    if f.file == "selftest_bad.py"})
+    if rules != ["HVD021", "HVD022"]:
+        return (f"selftest: expected HVD021+HVD022 in the bad fixture, "
+                f"got {rules or 'nothing'} "
+                f"({[f.format() for f in findings]})")
+    clean_hits = [f for f in findings if f.file == "selftest_clean.py"]
+    if clean_hits:
+        return (f"selftest: clean fixture flagged: "
+                f"{[f.format() for f in clean_hits]}")
+    hv21 = [f for f in findings if f.rule == "HVD021"]
+    if not any("thread entry 'Box._loop'" in f.message for f in hv21):
+        return ("selftest: HVD021 finding did not name the thread "
+                f"entry: {[f.message for f in hv21]}")
+    return None

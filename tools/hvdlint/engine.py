@@ -189,12 +189,19 @@ class _BaselineIndex:
 
 
 def analyze_paths(paths, baseline_path=None, env_registry_path=None,
-                  rules=None):
+                  rules=None, program_pass=None):
     """Run every rule over the given paths.
 
     Returns (findings, scanned_files). ``findings`` includes suppressed
     ones (``suppressed`` set to "inline"/"baseline") so callers can show
     or count them; live findings are those with ``suppressed == ""``.
+
+    ``program_pass`` is an optional whole-program rule: a callable
+    ``(ctxs, shared) -> findings`` invoked once with EVERY parsed
+    FileContext after the per-file rules ran. Contexts are only
+    retained when a program pass is present, so the default single-file
+    lint keeps its memory profile and timing. Program findings go
+    through the same inline-suppression and baseline machinery.
     """
     from . import rules as rules_mod
     active = rules if rules is not None else rules_mod.RULES
@@ -205,6 +212,7 @@ def analyze_paths(paths, baseline_path=None, env_registry_path=None,
         baseline_path)
 
     findings = []
+    ctxs = []
     for relpath in files:
         with open(relpath, encoding="utf-8") as f:
             source = f.read()
@@ -215,6 +223,8 @@ def analyze_paths(paths, baseline_path=None, env_registry_path=None,
                 INTEGRITY_RULE, relpath, exc.lineno or 1, 0,
                 f"file does not parse: {exc.msg}"))
             continue
+        if program_pass is not None:
+            ctxs.append(ctx)
         for line, code in ctx.bad_suppressions:
             findings.append(Finding(
                 INTEGRITY_RULE, relpath, line, 0,
@@ -232,6 +242,21 @@ def analyze_paths(paths, baseline_path=None, env_registry_path=None,
                     if baseline.consume(f, line_text):
                         f.suppressed = "baseline"
                 findings.append(f)
+
+    if program_pass is not None:
+        by_path = {c.relpath: c for c in ctxs}
+        for f in program_pass(ctxs, shared):
+            ctx = by_path.get(f.file)
+            if ctx is not None and \
+                    ctx.suppression_for(f.rule, f.line) is not None:
+                f.suppressed = "inline"
+            else:
+                line_text = ""
+                if ctx is not None and 0 <= f.line - 1 < len(ctx.lines):
+                    line_text = ctx.lines[f.line - 1].strip()
+                if baseline.consume(f, line_text):
+                    f.suppressed = "baseline"
+            findings.append(f)
 
     for e in baseline.bad:
         findings.append(Finding(

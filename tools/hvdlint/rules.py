@@ -216,6 +216,29 @@ def check_rank_divergence(ctx, shared):
 # HVD002 — lock order / self-deadlock
 # ---------------------------------------------------------------------------
 
+def _lock_kind_of(value):
+    """'lock'/'rlock' for a threading.Lock()/RLock() or
+    lockdep.lock(name)/lockdep.rlock(name) construction, else None —
+    the sanitizer wrapper (utils/lockdep.py) is a drop-in, so every
+    lock-aware rule must see through it."""
+    if not (isinstance(value, ast.Call) and
+            isinstance(value.func, ast.Attribute) and
+            isinstance(value.func.value, ast.Name)):
+        return None
+    owner, ctor = value.func.value.id, value.func.attr
+    if owner == "threading" and ctor in ("Lock", "RLock"):
+        return "rlock" if ctor == "RLock" else "lock"
+    if owner == "lockdep" and ctor in ("lock", "rlock"):
+        if ctor == "rlock":
+            return "rlock"
+        for kw in value.keywords:
+            if kw.arg == "reentrant" and \
+                    isinstance(kw.value, ast.Constant) and kw.value.value:
+                return "rlock"
+        return "lock"
+    return None
+
+
 def _lock_defs(tree):
     """Map lock symbols to kind. Keys: ("mod", name) for module-level
     locks, ("cls", ClassName, attr) for self.<attr> locks."""
@@ -224,13 +247,9 @@ def _lock_defs(tree):
         if not isinstance(node, ast.Assign):
             continue
         value = node.value
-        if not (isinstance(value, ast.Call) and
-                isinstance(value.func, ast.Attribute) and
-                isinstance(value.func.value, ast.Name) and
-                value.func.value.id == "threading" and
-                value.func.attr in ("Lock", "RLock")):
+        kind = _lock_kind_of(value)
+        if kind is None:
             continue
-        kind = "rlock" if value.func.attr == "RLock" else "lock"
         for t in node.targets:
             if isinstance(t, ast.Name):
                 cls = _enclosing_class(node)
