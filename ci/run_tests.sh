@@ -154,6 +154,19 @@ echo "--- elasticity plane (fast fail: autoscale hysteresis, grading, drain, bre
 # test_chaos_plane.py with the other drills.
 python -m pytest tests/test_elasticity.py -q -m "not slow"
 
+echo "--- alerting & run-history plane (fast fail: WAL wire format, burn-rate rules, incidents)"
+# The alerting plane (docs/alerts.md) is what pages when a run degrades
+# without dying: the durable metrics WAL (full/delta segments, torn-tail
+# tolerant), the pending->firing->resolved state machine with two-sided
+# hysteresis, multi-window burn-rate predicates, and incident capture
+# that bundles the history slice with stranded request ids. The suite is
+# process-local on virtual clocks and runs in seconds; the KV-pressure
+# drill that proves the lifecycle on a real engine rides
+# test_chaos_plane.py. The hvd_replay selftest round-trips synthetic
+# segments through the window query, --diff and the Perfetto export.
+python -m pytest tests/test_history.py tests/test_alerts.py -q -m "not slow"
+python tools/hvd_replay.py --selftest
+
 echo "--- perf attribution (fast fail: overlap math, roofline model, regression ledger)"
 # The perf-attribution plane (docs/profiling.md) is how every other
 # plane's "is it fast enough" question gets answered: trace

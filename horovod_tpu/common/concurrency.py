@@ -89,16 +89,24 @@ LOCK_RANKS = {
     "ReplicaSupervisorService._op_lock": 30,
     "LaunchDriverService._lock": 30,
     "RunFnService._lock": 30,
+    # 39 — alert evaluation: sits just OUTSIDE the observability rings
+    # because the firing path, still holding the manager lock, dumps
+    # the flight recorder (Tracer._lock, 40) and forces a history
+    # flush (HistoryWriter._cv, 43)
+    "AlertManager._lock": 39,
     # 40 — observability rings
     "Tracer._lock": 40,
     "Timeline._lock": 40,
     "NumericsMonitor._lock": 40,
     "NumericsMonitor._pending_lock": 41,
     "memory._lock": 42,
+    "HistoryWriter._cv": 43,
     # 50 — module singletons (lazy factories)
     "metrics._registry_lock": 50,
     "tracing._tracer_lock": 50,
     "numerics._monitor_lock": 50,
+    "history._writer_lock": 50,
+    "alerts._manager_lock": 50,
     # 60 — leaf instruments
     "_Family._lock": 60,
     "Counter._lock": 61,

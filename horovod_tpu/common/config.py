@@ -223,6 +223,37 @@ class HorovodConfig:
 # doc both fail the lint stage.)
 ENV_REGISTRY = (
     # -- config helpers (common/config.py:from_env) --------------------
+    ("HOROVOD_ALERT", True, "1", "utils/alerts.py",
+     "Set 0 to replace the AlertManager with a no-op (no rule "
+     "evaluation, no incidents; the hvd_alert_state gauges never "
+     "appear)."),
+    ("HOROVOD_ALERT_BREAKER_FLAPS", True, "3", "utils/alerts.py",
+     "Default rule pack: breaker trips within the rule window at or "
+     "above this count is a breaker-open flap."),
+    ("HOROVOD_ALERT_FOR_S", True, "5.0", "utils/alerts.py",
+     "Default for-duration hysteresis: a rule's predicate must hold "
+     "this many seconds before pending escalates to firing (and hold "
+     "clear as long before firing resolves)."),
+    ("HOROVOD_ALERT_GOODPUT_BURN", True, "2.0", "utils/alerts.py",
+     "Default rule pack: multi-window goodput burn rate (wasted-token "
+     "fraction over 1 - HOROVOD_ALERT_GOODPUT_SLO) above this in BOTH "
+     "the 60s and 15s windows fires serve_goodput_burn."),
+    ("HOROVOD_ALERT_GOODPUT_SLO", True, "0.9", "utils/alerts.py",
+     "Serving goodput SLO target (useful-token fraction) the burn-rate "
+     "rule's error budget is derived from."),
+    ("HOROVOD_ALERT_HBM_HEADROOM_FRAC", True, "0.10", "utils/alerts.py",
+     "Default rule pack: HBM headroom below this fraction of capacity "
+     "fires hbm_headroom (OOM territory)."),
+    ("HOROVOD_ALERT_INTERVAL_S", True, "1.0", "utils/alerts.py",
+     "Minimum seconds between AlertManager rule evaluations; ticks "
+     "inside the interval are a lock-free no-op on the instrument "
+     "path."),
+    ("HOROVOD_ALERT_NONFINITE_BURST", True, "3", "utils/alerts.py",
+     "Default rule pack: nonfinite gradient observations within the "
+     "rule window at or above this count is a nonfinite burst."),
+    ("HOROVOD_ALERT_TTFT_SLO_S", True, "2.0", "utils/alerts.py",
+     "Serving TTFT SLO (seconds) the rolling-p99 rule compares "
+     "against."),
     ("HOROVOD_AUTOTUNE", True, "0", "common/config.py",
      "Enable the online fusion-parameter autotuner."),
     ("HOROVOD_AUTOTUNE_LOG", True, None, "common/config.py",
@@ -339,6 +370,19 @@ ENV_REGISTRY = (
      "Two-level (intra/inter host) allgather."),
     ("HOROVOD_HIERARCHICAL_ALLREDUCE", True, "0", "common/config.py",
      "Two-level (ICI reduce-scatter + DCN allreduce) allreduce."),
+    ("HOROVOD_HISTORY", True, "1", "utils/history.py",
+     "Set 0 to disable the durable run-history WAL (per-rank "
+     "delta-encoded metrics snapshots + the event ring, written by a "
+     "background thread; what tools/hvd_replay.py reads)."),
+    ("HOROVOD_HISTORY_DIR", True, None, "utils/history.py",
+     "Directory history segments and the rank-0 run manifest are "
+     "written to (default: <tmp>/hvd-history)."),
+    ("HOROVOD_HISTORY_INTERVAL_S", True, "30.0", "utils/history.py",
+     "Seconds between history snapshots; pokes inside the interval "
+     "are a lock-free no-op on the instrument path."),
+    ("HOROVOD_HISTORY_MAX_MB", True, "64.0", "utils/history.py",
+     "On-disk budget per rank for history segments; the writer "
+     "rotates size-bounded segments and prunes the oldest past it."),
     ("HOROVOD_LOG_LEVEL", True, "WARNING", "common/config.py",
      "Framework log level (TRACE/DEBUG/INFO/WARNING/ERROR/FATAL)."),
     ("HOROVOD_LOG_TIMESTAMP", True, "0", "common/config.py",
@@ -570,6 +614,9 @@ ENV_REGISTRY = (
     ("HVD_LOCKDEP_STALL_S", False, "1.0", "utils/lockdep.py",
      "Seconds a lock-holding thread may block acquiring another lock "
      "before lockdep reports hold_while_blocking."),
+    ("HVD_RUN_LABEL", False, None, "utils/provenance.py",
+     "Free-form run label stamped into provenance blocks (history "
+     "run manifest; falls back to HVD_BENCH_LABEL)."),
     ("HVD_TF_NATIVE", False, "1", "tensorflow/native.py",
      "Set 0 to disable the TensorFlow native bridge."),
     ("HVD_TF_NATIVE_ADDR", False, None, "tensorflow/native.py",
@@ -595,6 +642,10 @@ ENV_REGISTRY = (
      "Force the flash-attention ablation legs on (1) or off (0)."),
     ("HVD_BENCH_FLIGHT", False, None, "bench.py",
      "Set 0 to skip the flight-recorder overhead gate in bench.py."),
+    ("HVD_BENCH_HISTORY", False, None, "bench.py",
+     "Set 0 to skip the history+alerts overhead gate (WAL poke + "
+     "alert tick riding instrument_step on vs off around the real "
+     "eager LM step, interleaved best-of; asserts <=2% overhead)."),
     ("HVD_BENCH_LABEL", False, None, "bench.py",
      "Free-form run label stamped into the bench JSON provenance "
      "(shows up as the run name in tools/hvd_perf.py reports)."),

@@ -19,6 +19,7 @@ serving side's "only swap forward" rule stands on.
 import os
 
 from ..utils import checkpoint as hvd_checkpoint
+from ..utils import history as hvd_history
 from ..utils import metrics as hvd_metrics
 
 
@@ -65,4 +66,9 @@ class WeightPublisher:
         self._metrics.event(
             "fleet_publish", generation=gen, step=int(step),
             dir=pointer["dir"], files=len(manifest.get("files", {})))
+        # Anchor the durable run history at every published generation
+        # (docs/alerts.md): hvd_replay --diff can then line two runs up
+        # by the fleet_publish events their WALs captured. Async — the
+        # commit hook must not wait on history fsync.
+        hvd_history.flush(wait=False)
         return gen

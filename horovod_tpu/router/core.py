@@ -65,6 +65,8 @@ import time
 from ..common import config
 from ..serving import tracing as serve_tracing
 from ..serving.queue import Request, RequestResult
+from ..utils import alerts as hvd_alerts
+from ..utils import history as hvd_history
 from ..utils import metrics as hvd_metrics
 from ..utils import tracing as hvd_tracing
 from . import policy as route_policy
@@ -438,6 +440,12 @@ class Router:
         self._check_wedged(now)
         if self.elastic is not None:
             self.elastic.tick(self, self.loads(), now)
+        # The alert plane rides the router tick as well (docs/
+        # alerts.md): in a routed fleet the router's clock is the one
+        # that sees breaker trips and fleet-level burn, and engines
+        # may tick rarely once drained.
+        hvd_history.poke(now)
+        hvd_alerts.tick(now)
         return done
 
     def run_to_completion(self, max_steps=100000):

@@ -42,6 +42,7 @@ filtering never leaves a request with nowhere to go.
 import time
 
 from ..common import config
+from ..utils import alerts as hvd_alerts
 from ..utils import metrics as hvd_metrics
 from . import policy as route_policy
 from .canary import SLOWindow, slo_breaches
@@ -225,8 +226,11 @@ class ElasticityController:
         self.state = "steady"          # steady | grading
         self.decisions = []            # (verdict, evidence) history
         self.transitions = []          # every state change, for drills
-        self._rolling = SLOWindow()
-        self._last_full = None
+        # One source of SLO-window truth (docs/alerts.md): the
+        # rolling/last-full container is the shared helper the alert
+        # rules' burn-rate math builds on, parameterized by the
+        # canary's SLOWindow accumulator.
+        self._win = hvd_alerts.RollingWindow(self.window, SLOWindow)
         self._grade = None
         self._pressure_since = None
         self._idle_since = None
@@ -248,27 +252,18 @@ class ElasticityController:
 
     def observe(self, result):
         """One terminal RequestResult from the router's step loop."""
-        self._rolling.observe(result)
+        self._win.observe(result)
         if self._grade is not None:
             self._grade["after"].observe(result)
-        if self._rolling.n >= self.window:
-            self._last_full, self._rolling = self._rolling, SLOWindow()
 
     def _recent_window(self):
-        if self._rolling.n:
-            return self._rolling
-        return self._last_full
+        return self._win.recent()
 
     def _freeze_baseline(self):
         """Snapshot the pre-change SLO window (the grading baseline)
         and start accumulation fresh, so post-change results can never
         contaminate the 'before' evidence."""
-        base = self._rolling
-        if base.n < max(self.window // 2, 1) and \
-                self._last_full is not None:
-            base = self._last_full
-        self._rolling = SLOWindow()
-        return base
+        return self._win.freeze()
 
     # -- the control loop (ticked from Router.step) ---------------------
 

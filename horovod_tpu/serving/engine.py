@@ -29,6 +29,8 @@ import numpy as np
 
 from ..common import config
 from ..common.exceptions import RanksLostError
+from ..utils import alerts as hvd_alerts
+from ..utils import history as hvd_history
 from ..utils import memory as hvd_memory
 from ..utils import metrics as hvd_metrics
 from ..utils import tracing as hvd_tracing
@@ -261,6 +263,12 @@ class ServeEngine:
         self.scheduler.begin_wave()
         dirty |= self._decode()
         self._refresh_gauges(force=dirty)
+        # Alerting + durable history ride the serve tick too
+        # (docs/alerts.md) — interval-throttled clock compares, on the
+        # engine's clock so drills with virtual time drive them.
+        now = self._clock()
+        hvd_history.poke(now)
+        hvd_alerts.tick(now)
         done, self._finished = self._finished, []
         return done
 
@@ -616,12 +624,17 @@ class ServeEngine:
         total = self._goodput_tokens + self._wasted_tokens
         if total:
             self._m_goodput_ratio.set(self._goodput_tokens / total)
+        # phase_ms/ttft_s ride the event so hvd_slo --history can
+        # rebuild the tail decomposition from history segments alone
+        # (runs that degrade without ever producing a flight dump).
         self._metrics.event("serve_retire",
                             request_id=req.request_id, slot=slot,
                             outcome=outcome, reason=reason,
                             tokens=len(st.generated),
                             generation=st.generation,
-                            trace_id=trace.trace_id)
+                            trace_id=trace.trace_id,
+                            phase_ms=phases or None,
+                            ttft_s=st.ttft_s)
         self._finished.append(RequestResult(
             req.request_id, tuple(st.generated), outcome,
             ttft_s=st.ttft_s, finish_ts=now, reason=reason,

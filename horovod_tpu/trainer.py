@@ -29,7 +29,9 @@ from .common.exceptions import PREEMPTED_EXIT_CODE
 from . import optim
 from .parallel import mesh as mesh_lib
 from .ops.compression import Compression
+from .utils import alerts as hvd_alerts
 from .utils import checkpoint as hvd_checkpoint
+from .utils import history as hvd_history
 from .utils import memory as hvd_memory
 from .utils import metrics as hvd_metrics
 from .utils import tracing as hvd_tracing
@@ -76,6 +78,12 @@ def instrument_step(step_fn, tokens_per_step=None, name="train",
     peak next to ``hvd_mfu`` — nulled on CPU the same way, since CPU
     backends expose no allocator stats. Overhead is bench-gated ≤2%
     (``HVD_BENCH_MEM``).
+
+    So does the alerting & run-history plane (docs/alerts.md,
+    default-on via ``HVD_HISTORY`` / ``HVD_ALERT``): every step pokes
+    the on-disk history writer and ticks the AlertManager — both are
+    interval-throttled clock compares that no-op on the vast majority
+    of steps, bench-gated ≤2% (``HVD_BENCH_HISTORY``).
     """
     reg = hvd_metrics.get_registry()
     if not reg.enabled:
@@ -220,6 +228,11 @@ def instrument_step(step_fn, tokens_per_step=None, name="train",
             pb = hvd_memory.step_peak_bytes()
             if pb is not None:
                 peak_hbm.labels(loop=name).set(pb)
+        # Alerting + durable history ride the same tick (docs/alerts.md):
+        # both are interval-throttled no-ops on the vast majority of
+        # steps (bench-gated ≤2%, HVD_BENCH_HISTORY).
+        hvd_history.poke()
+        hvd_alerts.tick()
         return out
 
     return wrapped

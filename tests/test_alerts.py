@@ -1,0 +1,395 @@
+"""Alerting plane (utils/alerts.py): burn-rate math, the shared
+RollingWindow container, RuleView windowed lookups, the
+pending -> firing -> resolved state machine with two-sided hysteresis,
+one-shot escalation (flight dump + incident file), and the default
+rule pack evaluated against synthetic registry traffic on a virtual
+clock — no sleeps, no real time.
+"""
+
+import json
+
+import pytest
+
+from horovod_tpu.utils import alerts as hvd_alerts
+from horovod_tpu.utils import history as hvd_history
+from horovod_tpu.utils import metrics as hvd_metrics
+
+
+@pytest.fixture
+def reg():
+    """Standalone registry; tests never touch the process singleton."""
+    return hvd_metrics.MetricsRegistry(rank=0)
+
+
+def _manager(reg, rules, tmp_path, **kw):
+    kw.setdefault("interval_s", 0.0)
+    kw.setdefault("incident_dir", str(tmp_path))
+    kw.setdefault("history_writer", hvd_history.NullHistoryWriter())
+    return hvd_alerts.AlertManager(registry=reg, rules=rules, **kw)
+
+
+def _no_dump(monkeypatch):
+    """Keep escalation hermetic: capture flight-dump reasons instead of
+    writing real dumps."""
+    reasons = []
+    monkeypatch.setattr("horovod_tpu.utils.tracing.dump_on_failure",
+                        reasons.append)
+    return reasons
+
+
+class _Acc:
+    """Minimal accumulator for RollingWindow (observe + n)."""
+
+    def __init__(self):
+        self.vals = []
+
+    def observe(self, v):
+        self.vals.append(v)
+
+    @property
+    def n(self):
+        return len(self.vals)
+
+
+class TestBurnRate:
+    def test_empty_window_is_zero(self):
+        assert hvd_alerts.burn_rate(0, 0, 0.9) == 0.0
+        assert hvd_alerts.burn_rate(100, 0, 0.9) == 0.0
+
+    def test_burn_one_at_the_slo_boundary(self):
+        # 10% bad against a 0.9 target spends the budget exactly.
+        assert hvd_alerts.burn_rate(90, 10, 0.9) == pytest.approx(1.0)
+        assert hvd_alerts.burn_rate(80, 20, 0.9) == pytest.approx(2.0)
+
+    def test_no_budget_means_infinite_burn(self):
+        assert hvd_alerts.burn_rate(99, 1, 1.0) == float("inf")
+
+
+class TestRollingWindow:
+    def test_rollover_retains_last_full(self):
+        w = hvd_alerts.RollingWindow(3, _Acc)
+        for v in (1, 2, 3):
+            w.observe(v)
+        assert w.last_full.vals == [1, 2, 3]
+        assert w.current.n == 0
+        w.observe(4)
+        assert w.recent().vals == [4]  # rolling wins once non-empty
+
+    def test_recent_falls_back_to_last_full(self):
+        w = hvd_alerts.RollingWindow(2, _Acc)
+        w.observe(1)
+        w.observe(2)
+        assert w.recent().vals == [1, 2]
+
+    def test_freeze_prefers_last_full_when_rolling_thin(self):
+        w = hvd_alerts.RollingWindow(4, _Acc)
+        for v in (1, 2, 3, 4):
+            w.observe(v)
+        w.observe(5)  # rolling has 1 < size//2 samples
+        base = w.freeze()
+        assert base.vals == [1, 2, 3, 4]
+        # rolling restarted either way
+        assert w.current.n == 0
+        # the last-full is retained so recent() still has history
+        assert w.recent() is not None
+
+    def test_freeze_uses_rolling_when_thick_enough(self):
+        w = hvd_alerts.RollingWindow(4, _Acc)
+        for v in (1, 2, 3, 4, 5, 6):
+            w.observe(v)
+        base = w.freeze()
+        assert base.vals == [5, 6]
+
+
+class TestRuleView:
+    def _view(self, reg, samplers=None, now=100.0):
+        return hvd_alerts.RuleView(reg.snapshot(max_events=0),
+                                   samplers or {}, now)
+
+    def test_value_sums_children_and_filters_labels(self, reg):
+        fam = reg.counter("t_ops", labels=("op",))
+        fam.labels(op="a").inc(3)
+        fam.labels(op="b").inc(4)
+        view = self._view(reg)
+        assert view.value("t_ops") == 7.0
+        assert view.value("t_ops", labels={"op": "a"}) == 3.0
+        assert view.value("t_missing", default=-1.0) == -1.0
+        assert view.has("t_ops") and not view.has("t_missing")
+
+    def test_delta_is_windowed_and_clamped(self, reg):
+        c = reg.counter("t_c")
+        c.inc(10)
+        sampler = hvd_alerts._Sampler()
+        sampler.add(40.0, 2.0)
+        sampler.add(65.0, 6.0)
+        samplers = {("v", "t_c", hvd_alerts._labels_key(None)): sampler}
+        view = self._view(reg, samplers, now=100.0)
+        # window start 70 -> cumulative-at-start is the t=65 sample
+        assert view.delta("t_c", 30.0) == 4.0   # 10 - 6
+        # window start 30 predates every sample -> oldest retained
+        assert view.delta("t_c", 70.0) == 8.0   # 10 - 2
+        # no sampler yet: whole lifetime is the window
+        view2 = self._view(reg, {}, now=100.0)
+        assert view2.delta("t_c", 30.0) == 10.0
+
+    def test_windowed_quantile_uses_count_deltas(self, reg):
+        h = reg.histogram("t_lat", buckets=(0.1, 1.0, 10.0))
+        for _ in range(50):
+            h.labels().observe(0.05)  # old fast traffic
+        snap_counts = [0] * 4
+        for v in reg.snapshot()["metrics"]["t_lat"]["values"]:
+            for i, c in enumerate(v["counts"]):
+                snap_counts[i] += c
+        sampler = hvd_alerts._Sampler()
+        sampler.add(50.0, snap_counts)
+        for _ in range(10):
+            h.labels().observe(5.0)   # recent slow traffic
+        samplers = {("h", "t_lat"): sampler}
+        view = self._view(reg, samplers, now=100.0)
+        # cumulative p50 dominated by the fast traffic
+        assert view.quantile("t_lat", 0.5) <= 0.1
+        # windowed p50 sees only the slow tail
+        assert view.quantile("t_lat", 0.5, window_s=30.0) > 1.0
+        assert view.window_count("t_lat", 30.0) == 10
+        assert view.quantile("t_missing", 0.5) is None
+
+
+class TestLifecycle:
+    def _rule(self, breach_box, **kw):
+        kw.setdefault("for_s", 5.0)
+        return hvd_alerts.Rule(
+            "t_rule", lambda view: (breach_box[0], {"v": 1}), **kw)
+
+    def test_pending_fires_after_for_duration(self, reg, tmp_path,
+                                              monkeypatch):
+        _no_dump(monkeypatch)
+        breach = [True]
+        mgr = _manager(reg, [self._rule(breach)], tmp_path)
+        mgr.tick(now=0.0)
+        assert mgr.states()["t_rule"]["state"] == "pending"
+        mgr.tick(now=3.0)
+        assert mgr.firing() == []       # held < for_s
+        mgr.tick(now=5.0)
+        assert mgr.firing() == ["t_rule"]
+        assert mgr.states()["t_rule"]["evidence"] == {"v": 1}
+
+    def test_blip_is_cancelled_not_fired(self, reg, tmp_path, monkeypatch):
+        _no_dump(monkeypatch)
+        breach = [True]
+        mgr = _manager(reg, [self._rule(breach)], tmp_path)
+        mgr.tick(now=0.0)
+        breach[0] = False
+        mgr.tick(now=2.0)
+        assert mgr.states()["t_rule"]["state"] == "inactive"
+        kinds = [e["event"] for e in reg.events()]
+        assert "alert_cancelled" in kinds and "alert_firing" not in kinds
+
+    def test_resolve_needs_clear_hold(self, reg, tmp_path, monkeypatch):
+        _no_dump(monkeypatch)
+        breach = [True]
+        mgr = _manager(reg, [self._rule(breach, for_s=0.0, clear_s=10.0)],
+                       tmp_path)
+        mgr.tick(now=0.0)   # zero for-duration fires on the same tick
+        assert mgr.firing() == ["t_rule"]
+        breach[0] = False
+        mgr.tick(now=1.0)
+        mgr.tick(now=5.0)
+        assert mgr.firing() == ["t_rule"]   # clear streak < clear_s
+        breach[0] = True
+        mgr.tick(now=6.0)   # re-breach resets the clear streak
+        breach[0] = False
+        mgr.tick(now=7.0)
+        mgr.tick(now=12.0)
+        assert mgr.firing() == ["t_rule"]   # streak restarted at 7
+        mgr.tick(now=17.0)
+        assert mgr.firing() == []
+        kinds = [e["event"] for e in reg.events()]
+        assert kinds.count("alert_firing") == 1
+        assert kinds.count("alert_resolved") == 1
+
+    def test_escalation_is_one_shot_per_episode(self, reg, tmp_path,
+                                                monkeypatch):
+        reasons = _no_dump(monkeypatch)
+        breach = [True]
+        mgr = _manager(reg, [self._rule(breach, for_s=0.0, clear_s=1.0)],
+                       tmp_path)
+        mgr.tick(now=0.0)
+        mgr.tick(now=1.0)   # still firing: no second dump
+        assert reasons == ["alert:t_rule"]
+        assert len(mgr.incidents) == 1
+        breach[0] = False
+        mgr.tick(now=2.0)
+        mgr.tick(now=4.0)   # resolved
+        breach[0] = True
+        mgr.tick(now=5.0)   # new episode fires again
+        assert reasons == ["alert:t_rule", "alert:t_rule"]
+        assert len(mgr.incidents) == 2
+
+    def test_state_gauge_and_transition_counters(self, reg, tmp_path,
+                                                 monkeypatch):
+        _no_dump(monkeypatch)
+        breach = [True]
+        mgr = _manager(reg, [self._rule(breach, for_s=5.0)], tmp_path)
+        mgr.tick(now=0.0)
+        snap = reg.snapshot(max_events=0)["metrics"]
+        assert snap["hvd_alert_state"]["values"][0]["value"] == 1.0
+        mgr.tick(now=5.0)
+        snap = reg.snapshot(max_events=0)["metrics"]
+        assert snap["hvd_alert_state"]["values"][0]["value"] == 2.0
+        trans = {tuple(sorted(v["labels"].items())): v["value"]
+                 for v in snap["hvd_alerts_total"]["values"]}
+        assert trans[(("alert", "t_rule"), ("transition", "pending"))] == 1
+        assert trans[(("alert", "t_rule"), ("transition", "firing"))] == 1
+
+    def test_broken_predicate_is_isolated(self, reg, tmp_path, monkeypatch):
+        _no_dump(monkeypatch)
+
+        def boom(view):
+            raise RuntimeError("predicate bug")
+
+        breach = [True]
+        rules = [hvd_alerts.Rule("t_boom", boom, for_s=0.0),
+                 self._rule(breach, for_s=0.0)]
+        mgr = _manager(reg, rules, tmp_path)
+        mgr.tick(now=0.0)   # must not raise; healthy rule still fires
+        assert mgr.firing() == ["t_rule"]
+        assert mgr.states()["t_boom"]["state"] == "inactive"
+
+    def test_interval_gates_evaluation(self, reg, tmp_path, monkeypatch):
+        _no_dump(monkeypatch)
+        breach = [True]
+        mgr = _manager(reg, [self._rule(breach, for_s=0.0)], tmp_path,
+                       interval_s=10.0)
+        mgr.tick(now=0.0)
+        assert mgr.firing() == ["t_rule"]
+        breach[0] = False
+        mgr.tick(now=5.0)   # before the deadline: not evaluated
+        mgr.tick(now=9.9)
+        assert mgr.firing() == ["t_rule"]
+
+
+class TestIncidentCapture:
+    def test_incident_bundles_history_events_and_stranded_ids(
+            self, reg, tmp_path, monkeypatch):
+        _no_dump(monkeypatch)
+        writer = hvd_history.HistoryWriter(
+            str(tmp_path), registry=reg, interval_s=3600.0)
+        try:
+            reg.event("serve_admit", request_id="req-1")
+            reg.event("serve_admit", request_id="req-2")
+            reg.event("serve_retire", request_id="req-1",
+                      phase_ms={"prefill": 30.0, "decode": 120.0},
+                      trace_id="tr-9")
+            writer.flush(wait=True)
+            breach = [True]
+            rule = hvd_alerts.Rule(
+                "t_inc", lambda view: (breach[0], {"why": "drill"}),
+                for_s=0.0, severity="page")
+            mgr = _manager(reg, [rule], tmp_path, history_writer=writer)
+            mgr.tick(now=0.0)
+        finally:
+            writer.close()
+        assert len(mgr.incidents) == 1
+        with open(mgr.incidents[0]) as f:
+            inc = json.load(f)
+        assert inc["alert"] == "t_inc"
+        assert inc["severity"] == "page"
+        assert inc["evidence"] == {"why": "drill"}
+        assert inc["stranded_request_ids"] == ["req-2"]
+        assert inc["dominant_phase"] == "decode"
+        assert "tr-9" in inc["trace_ids"]
+        assert inc["history"], "WAL slice must ride the incident"
+        assert inc["manifest"] is not None
+        kinds = [e["event"] for e in reg.events()]
+        assert "alert_incident" in kinds
+        # Incident counter bumped for this alert.
+        snap = reg.snapshot(max_events=0)["metrics"]
+        assert snap["hvd_incidents_total"]["values"][0]["value"] == 1.0
+
+
+class TestDefaultPack:
+    def test_goodput_burn_needs_both_windows_hot(self, reg, tmp_path,
+                                                 monkeypatch):
+        _no_dump(monkeypatch)
+        monkeypatch.setenv("HVD_ALERT_FOR_S", "5.0")
+        good = reg.counter("hvd_serve_goodput_tokens_total")
+        bad = reg.counter("hvd_serve_wasted_tokens_total")
+        rules = [r for r in hvd_alerts.default_rules()
+                 if r.name == "serve_goodput_burn"]
+        mgr = _manager(reg, rules, tmp_path)
+        # 100s of healthy traffic: burn stays cold.
+        now = 0.0
+        for _ in range(100):
+            good.inc(100)
+            mgr.tick(now=now)
+            now += 1.0
+        assert mgr.states()["serve_goodput_burn"]["state"] == "inactive"
+        # Waste spikes to 50% (5x burn at the 0.9 SLO). The short
+        # window goes hot almost immediately; the long one needs the
+        # damage to accrue against the healthy tail -> material spend.
+        for _ in range(40):
+            good.inc(50)
+            bad.inc(50)
+            mgr.tick(now=now)
+            now += 1.0
+        assert mgr.firing() == ["serve_goodput_burn"]
+        ev = mgr.states()["serve_goodput_burn"]["evidence"]
+        assert ev["burn_15s"] >= 2.0 and ev["burn_60s"] >= 2.0
+        # Load drops: the long window stays hot a while, but the short
+        # window cooling is enough to stop the breach -> resolves.
+        for _ in range(40):
+            good.inc(100)
+            mgr.tick(now=now)
+            now += 1.0
+        assert mgr.firing() == []
+
+    def test_ttft_rule_needs_min_volume(self, reg, tmp_path, monkeypatch):
+        _no_dump(monkeypatch)
+        h = reg.histogram("hvd_serve_ttft_seconds",
+                          buckets=(0.5, 1.0, 2.0, 4.0))
+        rules = [r for r in hvd_alerts.default_rules()
+                 if r.name == "serve_ttft_p99"]
+        mgr = _manager(reg, rules, tmp_path)
+        for _ in range(3):
+            h.labels().observe(3.5)   # slow but under min volume
+        mgr.tick(now=0.0)
+        assert mgr.states()["serve_ttft_p99"]["state"] == "inactive"
+        for _ in range(10):
+            h.labels().observe(3.5)
+        mgr.tick(now=1.0)
+        assert mgr.states()["serve_ttft_p99"]["state"] == "pending"
+
+    def test_stall_and_hbm_rules_read_gauges(self, reg, tmp_path,
+                                             monkeypatch):
+        _no_dump(monkeypatch)
+        rules = [r for r in hvd_alerts.default_rules()
+                 if r.name in ("stall", "hbm_headroom_low")]
+        mgr = _manager(reg, rules, tmp_path)
+        mgr.tick(now=0.0)
+        states = mgr.states()
+        assert states["stall"]["state"] == "inactive"
+        assert states["hbm_headroom_low"]["state"] == "inactive"
+        reg.gauge("hvd_stalled_ranks").set(2)
+        reg.gauge("hvd_hbm_capacity_bytes").set(16e9)
+        reg.gauge("hvd_hbm_headroom_bytes").set(0.5e9)  # 3% headroom
+        mgr.tick(now=1.0)
+        states = mgr.states()
+        assert states["stall"]["state"] == "pending"
+        assert states["hbm_headroom_low"]["state"] == "pending"
+
+    def test_pack_names_are_stable(self):
+        names = [r.name for r in hvd_alerts.default_rules()]
+        assert names == ["serve_goodput_burn", "serve_ttft_p99",
+                         "hbm_headroom_low", "recompile_storm", "stall",
+                         "nonfinite_burst", "breaker_flap"]
+
+
+class TestModuleFacade:
+    def test_reset_disabled_is_inert(self):
+        try:
+            mgr = hvd_alerts.reset(enabled=False)
+            assert not mgr.enabled
+            hvd_alerts.tick()
+            assert mgr.firing() == [] and mgr.states() == {}
+        finally:
+            hvd_alerts.reset(enabled=False)

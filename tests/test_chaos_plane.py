@@ -2351,3 +2351,191 @@ class TestDrillElasticity:
         finally:
             hvd_metrics.reset()
             hvd_tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# alerting & run-history plane drill: KV-pressure overload burns the
+# goodput budget, the alert fires inside its for-duration bound with a
+# durable incident, resolves once load drops, and the postmortem names
+# the whole episode from dumps alone (utils/alerts.py, docs/alerts.md)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestDrillAlertPlane:
+    """Drills (m), the alerting plane end to end on a REAL serving
+    engine: the AlertManager rides ``ServeEngine.step()`` exactly as in
+    production (no drill-only control loop), the engine runs on a
+    virtual clock (each step bills 250ms so the 60s/15s burn windows
+    cost hundreds of steps, not wall-minutes), and the episode must be
+    replayable from the flight dumps and the incident file alone."""
+
+    class _Clock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    def _engine(self, clock, cfg, params, num_slots):
+        from horovod_tpu.serving.engine import ServeEngine
+        from horovod_tpu.serving.queue import AdmissionQueue
+
+        eng = ServeEngine(
+            cfg, params, num_slots=num_slots, max_len=64, kv_block=8,
+            queue=AdmissionQueue(max_depth=64, admission_timeout_s=1e9,
+                                 clock=clock),
+            clock=clock)
+
+        def timed_step(engine=eng, clk=clock):
+            clk.t += 0.250
+            return type(engine).step(engine)
+
+        eng.step = timed_step
+        return eng
+
+    def _postmortem(self, tmp_path, hvd_tracing, reason):
+        hvd_tracing.get_tracer().dump(reason=reason)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import hvd_postmortem
+        loaded, bad = hvd_postmortem.load_dumps(
+            hvd_postmortem.find_dumps(str(tmp_path)))
+        assert not bad
+        hvd_postmortem.rebase(loaded)
+        return hvd_postmortem.analyze(loaded)
+
+    def test_kv_pressure_fires_goodput_burn_and_resolves(
+            self, tmp_path, monkeypatch):
+        """The KV-pressure drill: a healthy baseline, then an overload
+        whose requests blow their deadlines mid-decode — every one of
+        their tokens becomes wasted work, both burn windows go hot, and
+        ``serve_goodput_burn`` walks pending -> firing inside its
+        for-duration bound. The incident file names the dominant serve
+        phase and the requests stranded in slots at capture time; once
+        the overload stops the alert resolves through the clear-hold;
+        and the postmortem names the incident from dumps alone."""
+        import json as _json
+
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import transformer as tr
+        from horovod_tpu.serving.queue import Request
+        from horovod_tpu.utils import alerts as hvd_alerts
+        from horovod_tpu.utils import history as hvd_history
+        from horovod_tpu.utils import metrics as hvd_metrics
+        from horovod_tpu.utils import tracing as hvd_tracing
+
+        flight_dir = tmp_path / "flight"
+        hist_dir = tmp_path / "hist"
+        monkeypatch.setenv("HVD_FLIGHT_DIR", str(flight_dir))
+        monkeypatch.setenv("HVD_HISTORY_DIR", str(hist_dir))
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        hvd_metrics.reset(enabled=True)
+        hvd_tracing.reset(enabled=True, rank=0)
+        hvd_history.reset(enabled=True, dirpath=str(hist_dir), rank=0,
+                          interval_s=2.0)
+        mgr = hvd_alerts.reset(enabled=True)
+        rule = next(r for r in mgr.rules
+                    if r.name == "serve_goodput_burn")
+        try:
+            clock = self._Clock()
+            cfg = tr.TransformerConfig.tiny(dtype=jnp.float32,
+                                            attention_impl="full")
+            _, params = tr.init_params(cfg, jax.random.PRNGKey(0))
+            eng = self._engine(clock, cfg, params, 4)
+            results = []
+
+            def state():
+                return mgr.states()["serve_goodput_burn"]["state"]
+
+            # phase 1: ~70 virtual seconds of healthy traffic — every
+            # request completes, the burn windows fill with goodput.
+            i = 0
+            while clock.t < 70.0:
+                if len(eng.queue) < 2:
+                    eng.submit(Request(f"warm-{i}", (3, 1, 4),
+                                       max_new_tokens=2))
+                    i += 1
+                results.extend(eng.step())
+            assert state() == "inactive"
+
+            # phase 2: KV-pressure overload — slots saturate with
+            # decodes that blow staggered sub-second deadlines, so
+            # every admitted token is wasted work, by reason, and at
+            # any instant some requests sit admitted-but-unretired.
+            t_pending = t_firing = None
+            j = 0
+            guard = 0
+            while t_firing is None and guard < 400:
+                while len(eng.queue) < 4:
+                    eng.submit(Request(f"kv-{j}", (3, 1, 4),
+                                       max_new_tokens=16,
+                                       deadline_s=0.3 + 0.2 * (j % 3)))
+                    j += 1
+                results.extend(eng.step())
+                s = state()
+                if t_pending is None and s in ("pending", "firing"):
+                    t_pending = clock.t
+                if s == "firing":
+                    t_firing = clock.t
+                guard += 1
+            assert t_firing is not None, "goodput burn never fired"
+            # the for-duration hysteresis held: not a same-tick page,
+            # and firing landed within the bound (for_s plus one alert
+            # interval plus one step of tick granularity).
+            assert t_firing - t_pending >= rule.for_s
+            assert t_firing - t_pending <= rule.for_s + \
+                mgr.interval_s + 0.250 + 1e-6
+            ev = mgr.states()["serve_goodput_burn"]["evidence"]
+            assert ev["burn_60s"] >= ev["threshold"]
+            assert ev["burn_15s"] >= ev["threshold"]
+
+            # the incident file: dominant phase + stranded requests
+            incidents = [p for p in mgr.incidents
+                         if "serve_goodput_burn" in p]
+            assert len(incidents) == 1
+            with open(incidents[0]) as f:
+                inc = _json.load(f)
+            assert inc["alert"] == "serve_goodput_burn"
+            assert inc["severity"] == "page"
+            assert inc["dominant_phase"] is not None
+            assert inc["stranded_request_ids"], \
+                "overload left no admitted-but-unretired requests?"
+            assert all(r.startswith("kv-")
+                       for r in inc["stranded_request_ids"])
+            assert inc["history"], "incident carries no WAL slice"
+            assert inc["manifest"] is not None
+
+            # phase 3: the overload stops; the engine drains, the short
+            # window cools, and the alert resolves through clear_s.
+            guard = 0
+            while state() == "firing" and guard < 400:
+                if len(eng.queue) < 2:
+                    eng.submit(Request(f"cool-{j}", (3, 1, 4),
+                                       max_new_tokens=2))
+                    j += 1
+                results.extend(eng.step())
+                guard += 1
+            assert state() == "inactive"
+            assert "serve_goodput_burn" not in mgr.firing()
+
+            # the dumps alone name the episode: the firing escalation
+            # already dumped once (reason=alert:serve_goodput_burn);
+            # the postmortem reads those plus a final dump.
+            pm = self._postmortem(flight_dir, hvd_tracing,
+                                  "alert_plane_drill")
+            trans = [(t["alert"], t["transition"])
+                     for t in pm["alert_transitions"]]
+            assert ("serve_goodput_burn", "pending") in trans, trans
+            assert ("serve_goodput_burn", "firing") in trans, trans
+            assert ("serve_goodput_burn", "resolved") in trans, trans
+            assert any(i["alert"] == "serve_goodput_burn"
+                       for i in pm["incidents"])
+            assert any("incident for 'serve_goodput_burn'" in r
+                       for r in pm["reasons"]), pm["reasons"]
+        finally:
+            hvd_alerts.reset(enabled=False)
+            hvd_history.reset(enabled=False)
+            hvd_metrics.reset()
+            hvd_tracing.reset()

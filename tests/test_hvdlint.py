@@ -1562,11 +1562,129 @@ def test_hvd020_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# HVD023 — ad-hoc alert outside the alerting plane
+# ---------------------------------------------------------------------------
+
+def test_hvd023_triggers_on_quantile_threshold_with_warning(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=alert_path
+        import logging
+        from horovod_tpu.utils import metrics as hvd_metrics
+
+        log = logging.getLogger(__name__)
+
+        def watch(bounds, counts, slo):
+            p99 = hvd_metrics.histogram_quantile(bounds, counts, 0.99)
+            if p99 > slo:
+                log.warning("ttft p99 %s over slo %s", p99, slo)
+        """)
+    assert [f.rule for f in live(found)] == ["HVD023"]
+
+
+def test_hvd023_triggers_on_burn_rate_with_event_and_dump(tmp_path):
+    # the full private ladder: burn-rate compare -> event + flight dump
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=alert_path
+        from horovod_tpu.utils import metrics, tracing
+
+        def police(good, bad, target):
+            burn_rate = (bad / max(good + bad, 1)) / (1 - target)
+            if burn_rate > 4.0:
+                metrics.get_registry().event("goodput_burn", burn=burn_rate)
+                tracing.dump_on_failure("goodput_burn")
+        """)
+    assert [f.rule for f in live(found)] == ["HVD023"]
+
+
+def test_hvd023_compare_without_escalation_is_control_not_alert(tmp_path):
+    # thresholding a p99 to *actuate* (no warn/event/dump) is a control
+    # decision — the elastic/canary controllers' shape — not an alert
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=alert_path
+        def decide(win, slo):
+            ttft_p99 = win.ttft_p99()
+            if ttft_p99 > slo:
+                return "scale_up"
+            return "hold"
+        """)
+    assert live(found, "HVD023") == []
+
+
+def test_hvd023_escalation_without_slo_signal_not_flagged(tmp_path):
+    # warning on a plain state flag is the storm-ladder shape: no
+    # SLO-shaped read in the test, so no finding
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=alert_path
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def escalate(storming, misses):
+            if storming and misses > 4:
+                log.warning("recompile storm: %d misses", misses)
+        """)
+    assert live(found, "HVD023") == []
+
+
+def test_hvd023_fires_under_router_but_not_in_alerts_py(tmp_path):
+    reg = tmp_path / "fake_config.py"
+    reg.write_text(FAKE_REGISTRY)
+    src = ("import logging\n"
+           "log = logging.getLogger(__name__)\n\n"
+           "def watch(win, slo):\n"
+           "    ttft_p99 = win.p99()\n"
+           "    if ttft_p99 > slo:\n"
+           "        log.warning('over slo')\n")
+    router = tmp_path / "horovod_tpu" / "router"
+    router.mkdir(parents=True)
+    (router / "watchdog.py").write_text(src)
+    plane = tmp_path / "horovod_tpu" / "utils"
+    plane.mkdir(parents=True)
+    (plane / "alerts.py").write_text(src)
+    findings, _ = analyze_paths(
+        [str(router / "watchdog.py"), str(plane / "alerts.py")],
+        env_registry_path=str(reg))
+    assert [(f.rule, "router" in f.file) for f in live(findings)] == \
+        [("HVD023", True)]
+
+
+def test_hvd023_out_of_scope_without_role(tmp_path):
+    found = lint_source(tmp_path, """\
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def watch(p99, slo):
+            if p99 > slo:
+                log.warning("over slo")
+        """)
+    assert live(found, "HVD023") == []
+
+
+def test_hvd023_suppression_honored(tmp_path):
+    found = lint_source(tmp_path, """\
+        # hvdlint: role=alert_path
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def grade(after_p99, baseline_p99, x):
+            # hvdlint: disable=HVD023(in-plane grading actuates a rollback; the alerting plane watches hvd_route_breaker_trips_total)
+            if after_p99 > x * baseline_p99:
+                log.warning("graded change breached; rolling back")
+        """)
+    assert live(found) == []
+    assert [f.rule for f in found if f.suppressed == "inline"] == \
+        ["HVD023"]
+
+
+# ---------------------------------------------------------------------------
 # rule catalog + CLI + end-to-end gate
 # ---------------------------------------------------------------------------
 
 def test_every_rule_has_catalog_entry():
-    assert sorted(RULES) == [f"HVD{i:03d}" for i in range(1, 21)]
+    assert sorted(RULES) == \
+        [f"HVD{i:03d}" for i in range(1, 21)] + ["HVD023"]
     for rule in RULES.values():
         assert rule.summary
         assert len(rule.explain) > 200  # the full story, not a stub

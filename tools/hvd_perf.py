@@ -94,6 +94,8 @@ LEGS = (
         context_paths=(("mesh", "devices"),)),
     Leg("mem_overhead_pct", ("mem", "overhead_pct"),
         higher_better=False),
+    Leg("history_overhead_pct", ("history", "overhead_pct"),
+        higher_better=False),
     Leg("overlap_frac", ("overlap", "overlap_frac"),
         context_paths=_OVERLAP_CTX),
     Leg("overlap_exposed_comm_ms", ("overlap", "exposed_comm_ms_on"),

@@ -478,6 +478,42 @@ def analyze(dumps):
                     f"{e.get('stall_s')}s acquiring "
                     f"{e.get('lock_blocked_on')}")
 
+    # 11. alerting plane (utils/alerts.py; docs/alerts.md): the alert
+    # lifecycle that led up to this dump. A firing alert is itself what
+    # triggered many dumps (reason "alert:<name>"), and its
+    # alert_incident event names the incident file bundling the history
+    # slice — so "which SLO burned, when, and where is the evidence"
+    # is answerable from the dumps alone.
+    alert_transitions, incidents = [], []
+    for d in dumps:
+        for e in d.get("events", []):
+            kind = e.get("event") or ""
+            if not kind.startswith("alert_"):
+                continue
+            transition = kind[len("alert_"):]
+            if transition == "incident":
+                incidents.append({"dump_rank": _rank_of(d), **e})
+                reasons.append(
+                    f"alert: incident for '{e.get('alert')}' captured "
+                    f"at {e.get('path')} — the bundled history slice "
+                    f"has the alert window (read it with "
+                    f"tools/hvd_replay.py --incident)")
+            else:
+                alert_transitions.append(
+                    {**e, "dump_rank": _rank_of(d),
+                     "transition": transition})
+                if transition == "firing":
+                    ev = {k: v for k, v in e.items()
+                          if k not in ("event", "ts_us", "epoch_us",
+                                       "t_us", "alert", "severity")}
+                    reasons.append(
+                        f"alert: '{e.get('alert')}' FIRING "
+                        f"({e.get('severity')}) on evidence {ev}")
+                elif transition == "resolved":
+                    reasons.append(
+                        f"alert: '{e.get('alert')}' resolved — the "
+                        f"breach cleared and held clear")
+
     # the blocking tensor: a numerics anomaly names it directly (the
     # corrupt collective beats whatever happens to be waiting at dump
     # time), else the longest-waiting open negotiate span, else the
@@ -537,6 +573,8 @@ def analyze(dumps):
         "resharding_findings": resharding_findings,
         "memory_by_rank": memory_by_rank,
         "lockdep_findings": lockdep_findings,
+        "alert_transitions": alert_transitions,
+        "incidents": incidents,
     }
 
 
@@ -640,6 +678,13 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
             (e.get("event") or "")[len("lockdep_"):]
             for e in verdict["lockdep_findings"])
         lines.append(f"  lockdep        : {dict(kinds)}")
+    if verdict.get("alert_transitions"):
+        moves = [(e.get("alert"), e.get("transition"))
+                 for e in verdict["alert_transitions"]]
+        lines.append(f"  alerts         : {moves}")
+    if verdict.get("incidents"):
+        lines.append(f"  incidents      : "
+                     f"{[(e.get('alert'), e.get('path')) for e in verdict['incidents']]}")
     for r in verdict["reasons"]:
         lines.append(f"  - {r}")
     if verdict["chaos_injections"]:
@@ -649,6 +694,20 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
             lines.append(
                 f"      rank {c.get('rank')}: {c.get('fault')} on "
                 f"{c.get('service', '?')}/{c.get('message', '?')}")
+
+    if verdict.get("alert_transitions") or verdict.get("incidents"):
+        lines.append("")
+        lines.append("-- alert lifecycle (utils/alerts.py) " + "-" * 35)
+        for e in verdict.get("alert_transitions", []):
+            detail = {k: v for k, v in e.items()
+                      if k not in ("event", "ts_us", "epoch_us", "t_us",
+                                   "alert", "transition", "dump_rank")}
+            lines.append(f"  [{_fmt_us(e.get('t_us', 0))}] "
+                         f"{e.get('alert')}: {e.get('transition')} "
+                         f"{detail}")
+        for e in verdict.get("incidents", []):
+            lines.append(f"  incident: {e.get('alert')} -> "
+                         f"{e.get('path')}")
 
     if verdict.get("numerics_anomalies"):
         lines.append("")
@@ -729,7 +788,8 @@ def render_report(dumps, bad, verdict, cycles_by_rank, base_epoch):
                         "route_canary_begin", "route_promote",
                         "route_rollback", "recompile_storm",
                         "resharding_finding") or \
-                    kind.startswith("lockdep_"):
+                    kind.startswith("lockdep_") or \
+                    kind.startswith("alert_"):
                 ev.append((e.get("t_us", 0), _rank_of(d), e))
     if ev:
         lines.append("")
@@ -789,7 +849,8 @@ def chrome_trace(dumps, stitched):
                         "route_reroute", "route_canary_begin",
                         "route_promote", "route_rollback",
                         "recompile_storm", "resharding_finding") or \
-                    kind.startswith("lockdep_"):
+                    kind.startswith("lockdep_") or \
+                    kind.startswith("alert_"):
                 events.append({
                     "name": kind, "cat": "event", "ph": "i", "s": "g",
                     "ts": e.get("t_us", 0), "pid": pid, "tid": 0,

@@ -27,9 +27,16 @@ engine lanes. ``--selftest`` runs the analyzer against two synthetic
 trace sets (a KV-pressure tail, a slow-prefill tail) and asserts each
 verdict names the injected phase.
 
+For runs that degraded without ever producing a flight dump,
+``--history [DIR]`` runs the same tail analysis off the history WAL
+(horovod_tpu/utils/history.py): ``serve_retire`` events carry the
+exact ``phase_ms``/``ttft_s`` per request, and admitted-but-never-
+retired requests surface as the in-flight set (docs/alerts.md).
+
 Usage:
     python tools/hvd_slo.py [--dir DIR | dump.json ...]
         [--pct P] [--json] [--trace out.json] [--out report.txt]
+    python tools/hvd_slo.py --history [DIR] [--pct P] [--json]
 
 Runbook: docs/troubleshooting.md ("Why is my p99 slow").
 """
@@ -41,10 +48,12 @@ import os
 import sys
 
 try:
+    from horovod_tpu.utils import history as hvd_history
     from horovod_tpu.utils import tracing as hvd_tracing
 except ImportError:  # run straight from a checkout: tools/ is no package
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    from horovod_tpu.utils import history as hvd_history
     from horovod_tpu.utils import tracing as hvd_tracing
 
 if __package__ in (None, ""):
@@ -143,6 +152,76 @@ def requests_from_dumps(dumps):
     return records
 
 
+def requests_from_history(events, rank=0):
+    """Request records from the history WAL's event stream — the
+    no-flight-dump path (docs/alerts.md).
+
+    ``serve_retire`` events carry the exact ``phase_ms`` decomposition
+    and ``ttft_s`` precisely so this reconstruction works from disk
+    alone; ``serve_admit`` events without a matching retire are the
+    stranded in-flight requests, extended to the last event timestamp
+    (phase decomposition unknown — the WAL records outcomes, not
+    spans). Requeue counts are not evented, so KV pressure is inferred
+    from requeue phase time being present at all.
+    """
+    records = []
+    admits = {}
+    last_epoch = max((e.get("epoch_us", 0) for e in events), default=0)
+    for e in events:
+        kind = e.get("event")
+        rid = e.get("request_id")
+        if rid is None:
+            continue
+        if kind == "serve_admit":
+            admits[rid] = e
+        elif kind == "serve_retire":
+            admits.pop(rid, None)
+            phases = dict(e.get("phase_ms") or {})
+            records.append({
+                "request_id": rid,
+                "trace_id": e.get("trace_id"),
+                "rank": rank,
+                "inflight": False,
+                "outcome": e.get("outcome", "?"),
+                "reason": e.get("reason", ""),
+                "slot": e.get("slot"),
+                "requeues": 1 if phases.get("requeue") else 0,
+                "total_ms": round(sum(phases.values()), 3),
+                "phase_ms": phases,
+            })
+    for rid, e in admits.items():
+        records.append({
+            "request_id": rid,
+            "trace_id": e.get("trace_id"),
+            "rank": rank,
+            "inflight": True,
+            "outcome": "inflight",
+            "reason": "",
+            "slot": e.get("slot"),
+            "requeues": 0,
+            "total_ms": round(
+                max(last_epoch - e.get("epoch_us", 0), 0) / 1e3, 3),
+            "phase_ms": {},
+        })
+    return records
+
+
+def analyze_history(dirpath, pct=None, rank=0):
+    """Tail verdict straight off history segments — for runs that
+    degraded without ever producing a flight dump. Returns the same
+    verdict dict as :func:`analyze_serve` plus the event counts the
+    reconstruction was based on."""
+    records_raw, torn = hvd_history.read_records(dirpath, rank)
+    events, missed = hvd_history.read_events(records_raw)
+    sheds = [e for e in events if e.get("event") == "route_shed"]
+    verdict = analyze_records(
+        requests_from_history(events, rank=rank), sheds, pct=pct)
+    verdict["source"] = {"history_dir": dirpath, "rank": rank,
+                         "records": len(records_raw), "torn": torn,
+                         "events": len(events), "missed": missed}
+    return verdict
+
+
 # -- tail classification ----------------------------------------------------
 
 def _dominant(record):
@@ -205,12 +284,21 @@ def analyze_serve(dumps, pct=None):
     the ones that got nothing at all. The verdict names them and their
     reasons (docs/elasticity.md).
     """
-    if pct is None:
-        pct = float(os.environ.get("HVD_SLO_PCT", "90"))
-    records = requests_from_dumps(dumps)
-    records.sort(key=lambda r: r["total_ms"], reverse=True)
     sheds = [e for d in dumps for e in d.get("events", [])
              if e.get("event") == "route_shed"]
+    return analyze_records(requests_from_dumps(dumps), sheds, pct=pct)
+
+
+def analyze_records(records, sheds=(), pct=None):
+    """The analysis core behind :func:`analyze_serve`, shared with the
+    history path (:func:`analyze_history`): takes the reconstructed
+    request records wherever they came from — flight-dump spans or the
+    history WAL's ``serve_retire`` events — plus any ``route_shed``
+    events, and produces the same verdict dict."""
+    if pct is None:
+        pct = float(os.environ.get("HVD_SLO_PCT", "90"))
+    records = sorted(records, key=lambda r: r["total_ms"], reverse=True)
+    sheds = list(sheds)
     shed_reasons = dict(collections.Counter(
         e.get("reason", "?") for e in sheds))
     out = {
@@ -506,6 +594,40 @@ def selftest():
     assert "dominated by" in report
     trace = slot_trace(dumps)
     assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+    # --history path: the same verdict machinery off WAL events alone —
+    # no spans, no flight dump, just serve_admit/serve_retire records
+    import shutil
+    import tempfile
+
+    from horovod_tpu.utils import metrics as hvd_metrics
+    hist = tempfile.mkdtemp(prefix="hvd-slo-history-")
+    try:
+        reg = hvd_metrics.MetricsRegistry(rank=0)
+        writer = hvd_history.HistoryWriter(hist, rank=0, interval_s=0.01,
+                                           max_mb=1, registry=reg)
+        for i in range(9):
+            reg.event("serve_retire", request_id=f"fast-{i}",
+                      outcome="completed", reason="", slot=0, tokens=8,
+                      phase_ms={"queue_wait": 1.0, "prefill": 2.0,
+                                "decode": 10.0}, ttft_s=0.01)
+        for i in range(3):
+            reg.event("serve_retire", request_id=f"slow-{i}",
+                      outcome="completed", reason="", slot=0, tokens=8,
+                      phase_ms={"queue_wait": 400.0, "requeue": 220.0,
+                                "prefill": 2.0, "decode": 10.0},
+                      ttft_s=0.7)
+        reg.event("serve_admit", request_id="stuck-0", slot=1)
+        writer.flush(wait=True)
+        writer.close()
+        hv = analyze_history(hist, pct=90)
+        assert hv["requests"] == 13, hv
+        assert hv["dominant_phase"] in ("queue_wait", "requeue"), hv
+        assert hv["kv_pressure"], hv
+        assert hv["inflight"] == ["stuck-0"], hv
+        assert hv["source"]["records"] >= 1, hv
+    finally:
+        shutil.rmtree(hist, ignore_errors=True)
     print("hvd_slo --selftest: ok "
           f"(kv verdict: {kv['verdict']!r}; "
           f"prefill verdict: {pf['verdict']!r})")
@@ -532,12 +654,39 @@ def main(argv=None):
                     help="also write the Perfetto slot timeline here")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write the report here instead of stdout")
+    ap.add_argument("--history", nargs="?", const="", default=None,
+                    metavar="DIR",
+                    help="analyze the history WAL instead of flight "
+                         "dumps (default DIR: HVD_HISTORY_DIR)")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="history rank to analyze (with --history)")
     ap.add_argument("--selftest", action="store_true",
                     help="run the built-in synthetic-tail checks")
     args = ap.parse_args(argv)
 
     if args.selftest:
         return selftest()
+
+    if args.history is not None:
+        from horovod_tpu.utils import history as history_mod
+        hist_dir = args.history or history_mod.history_dir()
+        verdict = analyze_history(hist_dir, pct=args.pct, rank=args.rank)
+        if verdict["requests"] == 0 and not verdict["shed"]:
+            print(f"hvd_slo: no serve events in the history WAL under "
+                  f"{hist_dir}", file=sys.stderr)
+            return 2
+        if args.trace:
+            print("hvd_slo: --trace needs span-level flight dumps; the "
+                  "history WAL has none (try hvd_replay --trace)",
+                  file=sys.stderr)
+        text = (json.dumps(verdict, indent=2, sort_keys=True)
+                if args.json else render_report([], verdict))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return 0
 
     paths = args.dumps or hvd_postmortem.find_dumps(args.dir)
     if not paths:

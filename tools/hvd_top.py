@@ -212,6 +212,37 @@ def render(snap, ranks_view, prev=None, dt=0.0, color=True):
     if not lost and not stalled and not stalled_t:
         lines.append(c(GREEN, "  healthy — no stalls, no lost ranks"))
 
+    # alerting plane: live rule states from the AlertManager
+    # (horovod_tpu/utils/alerts.py; docs/alerts.md). State gauge values:
+    # 0 inactive, 1 pending (breach held < for_s), 2 firing.
+    alert_state = _by_label(snap, "hvd_alert_state", "alert")
+    incidents = _by_label(snap, "hvd_incidents_total", "alert")
+    if alert_state or incidents:
+        lines.append(c(BOLD, "  alerts"))
+        firing = sorted(a for a, v in alert_state.items() if v >= 2)
+        pending = sorted(a for a, v in alert_state.items() if v == 1)
+        for name in firing:
+            inc = incidents.get(name, 0)
+            lines.append(c(RED, f"    FIRING        {name}"
+                               f"{f'   incidents {int(inc)}' if inc else ''}"))
+        for name in pending:
+            lines.append(c(YELLOW, f"    pending       {name}"))
+        if not firing and not pending:
+            n_rules = len(alert_state)
+            lines.append(c(GREEN, f"    all quiet     "
+                                  f"({n_rules} rule(s) evaluated)"))
+        trans = snap.get("metrics", {}).get("hvd_alerts_total")
+        if trans and trans.get("values"):
+            by_kind = {}
+            for v in trans["values"]:
+                kind = v.get("labels", {}).get("transition", "?")
+                by_kind[kind] = by_kind.get(kind, 0) + v.get("value", 0)
+            t_s = "  ".join(f"{k}={int(v):,}"
+                            for k, v in sorted(by_kind.items()))
+            total_inc = sum(incidents.values())
+            lines.append(f"    transitions   {t_s}   "
+                         f"incidents {int(total_inc):,}")
+
     # negotiation / control plane
     cyc = _total(snap, "hvd_coordinator_cycles_total") or \
         _total(snap, "hvd_negotiation_cycles_total")
@@ -770,6 +801,17 @@ def canned_snapshot():
                 labels=("fault",)).labels(fault="drop_response").inc(5)
     reg.gauge("hvd_stalled_ranks", "g").set(1)
     reg.gauge("hvd_stalled_tensors", "g").set(2)
+    ast = reg.gauge("hvd_alert_state", "g", labels=("alert",))
+    ast.labels(alert="serve_goodput_burn").set(2)
+    ast.labels(alert="ttft_p99_slo").set(1)
+    ast.labels(alert="hbm_headroom").set(0)
+    at = reg.counter("hvd_alerts_total", "c",
+                     labels=("alert", "transition"))
+    at.labels(alert="serve_goodput_burn", transition="pending").inc()
+    at.labels(alert="serve_goodput_burn", transition="firing").inc()
+    at.labels(alert="ttft_p99_slo", transition="pending").inc()
+    reg.counter("hvd_incidents_total", "c", labels=("alert",)).labels(
+        alert="serve_goodput_burn").inc()
     sh = reg.histogram("hvd_step_seconds", "h", labels=("loop",))
     for _ in range(100):
         sh.labels(loop="train").observe(0.085)

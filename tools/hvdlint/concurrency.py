@@ -582,7 +582,10 @@ def _check_class(mod, cname, cm, reach):
     # -- interprocedural entry-held fixpoint ---------------------------
     roots_set = _as_roots(reach)
     entry_held = {name: frozenset() for name in cm.methods}
-    for _ in range(3):
+    # Bounded fixpoint; converges (and breaks) in chain-depth rounds.
+    # 8 covers the deepest real chain (AlertManager.tick -> _evaluate
+    # -> _advance -> _fire -> _escalate -> _write_incident) with slack.
+    for _ in range(8):
         callsites = {}  # method -> list of held frozensets at its calls
 
         def on_call(node, held, _cs=callsites):

@@ -1560,6 +1560,99 @@ def check_adhoc_memory_probe(ctx, shared):
 
 
 # ---------------------------------------------------------------------------
+# HVD023 — ad-hoc alert outside the alerting plane
+# ---------------------------------------------------------------------------
+
+# The alerting plane (utils/alerts.py, docs/alerts.md) is the one
+# sanctioned home for "metric crosses threshold -> escalate" logic.
+# Everywhere else, an If that thresholds an SLO-shaped signal and
+# escalates in its body is a private alert: no pending->firing
+# hysteresis (it flaps on one bad sample), no resolved edge, no
+# incident capture, and its threshold never reaches the rule pack an
+# operator can read.
+_ALERT_SANCTIONED_SUFFIXES = ("horovod_tpu/utils/alerts.py",)
+_ALERT_SCOPE_DIRS = ("horovod_tpu/serving/", "horovod_tpu/router/",
+                     "horovod_tpu/ops/", "horovod_tpu/utils/")
+_ALERT_SCOPE_FILES = ("horovod_tpu/trainer.py",)
+# SLO-shaped signals on the test side: a windowed quantile, a burn
+# rate, or a named pXX value
+_ALERT_SIGNAL_CALLS = {"histogram_quantile", "burn_rate"}
+_ALERT_SIGNAL_NAMES = {"p50", "p90", "p95", "p99"}
+_ALERT_SIGNAL_SUFFIXES = ("_p99", "_p95", "_p90", "_p50")
+_ALERT_SIGNAL_SUBSTRINGS = ("burn_rate", "burnrate")
+# escalation terminals in the body: the ladder a real alert rides
+_ALERT_ESCALATION_ATTRS = {"warning", "warn", "error", "critical",
+                           "dump", "dump_on_failure", "event"}
+
+
+def _terminal_name(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _alert_signal_in(test):
+    """The SLO-shaped read inside an If test, or None."""
+    for t in ast.walk(test):
+        if isinstance(t, ast.Call):
+            name = _terminal_name(t.func)
+            if name in _ALERT_SIGNAL_CALLS:
+                return f"{name}(...)"
+        elif isinstance(t, (ast.Name, ast.Attribute)):
+            name = t.id if isinstance(t, ast.Name) else t.attr
+            low = name.lower()
+            if low in _ALERT_SIGNAL_NAMES or \
+                    low.endswith(_ALERT_SIGNAL_SUFFIXES) or \
+                    any(s in low for s in _ALERT_SIGNAL_SUBSTRINGS):
+                return name
+    return None
+
+
+def check_adhoc_alert(ctx, shared):
+    if ctx.relpath.endswith(_ALERT_SANCTIONED_SUFFIXES):
+        return
+    if "alert_path" not in ctx.roles and not (
+            any(d in ctx.relpath for d in _ALERT_SCOPE_DIRS) or
+            any(ctx.relpath.endswith(f) for f in _ALERT_SCOPE_FILES)):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.If):
+            continue
+        # reading a quantile is fine; THRESHOLDING it is the alert shape
+        if not any(isinstance(t, ast.Compare)
+                   for t in ast.walk(node.test)):
+            continue
+        signal = _alert_signal_in(node.test)
+        if signal is None:
+            continue
+        escalation = None
+        for stmt in node.body:
+            for t in ast.walk(stmt):
+                if isinstance(t, ast.Call) and \
+                        _terminal_name(t.func) in _ALERT_ESCALATION_ATTRS:
+                    escalation = _terminal_name(t.func)
+                    break
+            if escalation:
+                break
+        if escalation is None:
+            continue
+        yield Finding(
+            "HVD023", ctx.relpath, node.lineno, node.col_offset,
+            f"ad-hoc alert: thresholding SLO signal '{signal}' and "
+            f"escalating via '{escalation}(...)' outside the alerting "
+            "plane. A private threshold-and-warn has no pending->firing "
+            "hysteresis (one bad sample flaps it), no resolved edge, no "
+            "incident capture, and its threshold is invisible to the "
+            "rule pack operators read. Declare it as a Rule on "
+            "utils/alerts.py's AlertManager (docs/alerts.md) so the "
+            "breach rides the shared lifecycle — or, for an in-plane "
+            "*control* decision that actuates rather than pages, keep "
+            "it with a disable reason naming the actuator.")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -2179,5 +2272,47 @@ for byte *attribution* rather than measurement — account the tree
 into the ledger (``get_ledger().account_tree(...)``) and let the
 gauges carry the number.""",
             check_adhoc_memory_probe),
+        Rule(
+            "HVD023", "adhoc-alert",
+            "threshold-and-escalate on an SLO signal outside the "
+            "alerting plane",
+            """HVD023 — ad-hoc alert outside the alerting plane
+
+The alerting plane gives "metric crosses threshold" exactly one front
+door: a declarative ``Rule`` on ``utils/alerts.py``'s AlertManager,
+evaluated on the existing instrument ticks. A rule there gets the
+whole lifecycle for free — pending->firing hysteresis (a breach must
+hold HVD_ALERT_FOR_S before paging, and hold clear before resolving),
+multi-window burn-rate predicates, the ``hvd_alert_state`` gauge
+hvd_top renders, the one-shot flight-dump escalation, and an incident
+file bundling the alert window's durable history slice
+(docs/alerts.md).
+
+An ``if ttft_p99 > slo: log.warning(...)`` anywhere else is a private
+alert with none of that: it flaps on a single bad sample, never
+resolves, pages nobody consistently (the warning drowns in the log),
+and captures no evidence — by the time a human reads it, the window
+that explains it has rolled out of every ring. The historical shape:
+a debugging guard that ships, then three planes each grow their own
+slightly different p99 threshold and an operator cannot answer "what
+alerts exist and at what levels" without grepping.
+
+Flags ``If`` statements whose test THRESHOLDS (contains a comparison
+over) an SLO-shaped signal — a ``histogram_quantile``/``burn_rate``
+call or a name ending in ``_p99/_p95/_p90/_p50`` or containing
+``burn_rate`` — and whose body escalates (``log.warning/error``,
+``warnings.warn``, a flight ``dump``/``dump_on_failure``, or a
+registry ``event``). Scope: horovod_tpu/serving/, router/, ops/,
+utils/ and trainer.py (other files opt in with ``# hvdlint:
+role=alert_path``); utils/alerts.py itself is the sanctioned home.
+Reading a quantile without comparing it, or comparing without
+escalating (a control decision that only actuates), is not flagged.
+
+Fix: declare the predicate as a Rule in the AlertManager's pack (or
+extend ``default_rules()``); for a deliberate in-plane control ladder
+that actuates rather than pages (canary rollback, elastic grading),
+keep it with a disable reason naming the actuator and the metric the
+alerting plane watches instead.""",
+            check_adhoc_alert),
     ]
 }
